@@ -1,0 +1,34 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A brand-new framework with the capabilities of 2017-era PaddlePaddle
+(reference surveyed in SURVEY.md) rebuilt idiomatically on JAX/XLA/Pallas:
+
+- a config-driven layer/network system (reference: paddle/gserver/layers,
+  python/paddle/trainer/config_parser.py) where forward passes are pure
+  functions and gradients come from ``jax.grad`` rather than hand-written
+  backward methods;
+- padding-free variable-length sequence semantics expressed as dense
+  [B, T] arrays plus length metadata (reference: paddle/parameter/Argument.h:84-93)
+  with ``lax.scan`` recurrence instead of per-timestep frame networks;
+- data/model parallelism via ``jax.sharding.Mesh`` + ``shard_map`` and XLA
+  collectives over ICI (reference: MultiGradientMachine ring + C++/Go
+  parameter servers, paddle/pserver, go/pserver);
+- an event-driven Python training API with reader combinators and
+  checkpointing (reference: python/paddle/v2).
+"""
+
+__version__ = "0.1.0"
+
+from paddle_tpu.core import config, registry  # noqa: F401
+from paddle_tpu.core.arg import Arg  # noqa: F401
+from paddle_tpu.core.mesh import get_mesh, set_mesh  # noqa: F401
+
+
+def init(**flags):
+    """Process-level init, analogous to paddle.init / initMain
+    (reference: paddle/trainer/TrainerMain.cpp:32, paddle/utils/Flags.cpp).
+    Accepts keyword flags stored in the global flag registry."""
+    from paddle_tpu.core import flags as _flags
+
+    for k, v in flags.items():
+        _flags.set_flag(k, v)

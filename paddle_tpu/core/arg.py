@@ -1,0 +1,90 @@
+"""Arg — the inter-layer data packet.
+
+Capability equivalent of the reference's Argument
+(paddle/parameter/Argument.h:29,71-93): value + optional integer ids +
+sequence metadata for variable-length and nested sequences.
+
+TPU-first redesign: the reference stores a flat [sum(T_i), D] value with
+`sequenceStartPositions` offsets (padding-free, dynamic shapes). XLA wants
+static shapes, so sequences are DENSE-PACKED: value is [B, T, ...] padded to
+the bucket length, `seq_lens` is [B] int32, and masks are derived on demand.
+Nested (sub-)sequences carry a second level: `subseq_lens` [B, S] giving the
+length of each sub-sequence, padded with zeros. All framework kernels
+(pooling, last-instance, softmax over sequence, scan recurrence, CTC/CRF)
+respect the mask so padding never changes results — the same *semantics* as
+padding-free, in a compiler-friendly layout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class Arg:
+    # dense value: [B, ...] (non-seq) or [B, T, ...] (seq)
+    value: Optional[jax.Array] = None
+    # integer ids, same leading shape as value (sparse/index inputs)
+    ids: Optional[jax.Array] = None
+    # [B] int32 lengths; None => not a sequence
+    seq_lens: Optional[jax.Array] = None
+    # [B, S] int32 sub-sequence lengths (nested sequences); zero-padded
+    subseq_lens: Optional[jax.Array] = None
+
+    # -- properties --
+    @property
+    def is_seq(self) -> bool:
+        return self.seq_lens is not None
+
+    @property
+    def has_subseq(self) -> bool:
+        return self.subseq_lens is not None
+
+    @property
+    def batch(self) -> int:
+        a = self.value if self.value is not None else self.ids
+        return a.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        a = self.value if self.value is not None else self.ids
+        return a.shape[1]
+
+    def mask(self, dtype=jnp.float32) -> jax.Array:
+        """[B, T] 1.0 where a timestep is real, 0.0 where padding."""
+        assert self.is_seq
+        t = self.max_len
+        pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+        return (pos < self.seq_lens[:, None]).astype(dtype)
+
+    def bool_mask(self) -> jax.Array:
+        assert self.is_seq
+        pos = jnp.arange(self.max_len, dtype=jnp.int32)[None, :]
+        return pos < self.seq_lens[:, None]
+
+    def with_value(self, value: jax.Array) -> "Arg":
+        return replace(self, value=value)
+
+    def total_tokens(self) -> jax.Array:
+        """Number of real (unpadded) timesteps in the batch."""
+        assert self.is_seq
+        return jnp.sum(self.seq_lens)
+
+
+def non_seq(value: jax.Array) -> Arg:
+    return Arg(value=value)
+
+
+def seq(value: jax.Array, seq_lens: jax.Array) -> Arg:
+    return Arg(value=value, seq_lens=jnp.asarray(seq_lens, jnp.int32))
+
+
+def id_arg(ids: jax.Array, seq_lens=None) -> Arg:
+    if seq_lens is not None:
+        seq_lens = jnp.asarray(seq_lens, jnp.int32)
+    return Arg(ids=jnp.asarray(ids, jnp.int32), seq_lens=seq_lens)

@@ -1,0 +1,54 @@
+"""Global process flags.
+
+Mirrors the reference's 27 gflags (paddle/utils/Flags.cpp:18-82) in
+capability: a typed global key/value store consulted by the trainer,
+data pipeline and parallel runtime. TPU-specific flags replace
+GPU-specific ones (use_gpu -> platform, trainer_count -> mesh shape).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+_DEFAULTS: dict[str, Any] = {
+    # device / mesh
+    "platform": None,  # None = jax default; "cpu" forces host backend
+    "mesh_shape": None,  # e.g. {"data": 8} — default: all devices on "data"
+    # training loop
+    "log_period": 100,
+    "show_parameter_stats_period": 0,
+    "test_period": 0,
+    "seed": 0,  # 0 = nondeterministic seed from OS entropy
+    "save_dir": None,
+    "saving_period": 1,
+    "save_only_one": False,
+    "start_pass": 0,
+    # data
+    "prefetch_depth": 2,
+    # precision policy: params in float32, matmuls in bfloat16 by default
+    "default_dtype": "float32",
+    "matmul_precision": "default",
+    # generation
+    "beam_size": 1,
+    # distributed control plane
+    "coordinator_address": None,
+    "process_id": 0,
+    "num_processes": 1,
+}
+
+_flags: dict[str, Any] = dict(_DEFAULTS)
+
+
+def get_flag(name: str) -> Any:
+    if name not in _flags:
+        raise KeyError(f"unknown flag {name!r}")
+    return _flags[name]
+
+
+def set_flag(name: str, value: Any) -> None:
+    _flags[name] = value
+
+
+def reset_flags() -> None:
+    _flags.clear()
+    _flags.update(_DEFAULTS)

@@ -1,0 +1,23 @@
+"""RNG plumbing: explicit, splittable JAX PRNG keys.
+
+The reference seeds a global generator per thread (paddle/utils/Util.h
+ThreadLocalRand); JAX is functional, so the trainer owns a root key and
+splits per purpose (init / dropout / sampling) and per step.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def root_key(seed: int = 0) -> jax.Array:
+    if seed == 0:
+        seed = int.from_bytes(os.urandom(4), "little")
+    return jax.random.key(seed)
+
+
+def split_for_step(key: jax.Array, step) -> jax.Array:
+    """Derive a per-step key (fold_in keeps it O(1) state)."""
+    return jax.random.fold_in(key, step)

@@ -1,0 +1,71 @@
+"""Timers and stats.
+
+Capability match for the reference's Stat/StatSet + REGISTER_TIMER macros
+(paddle/utils/Stat.h:63,114,244) and per-layer timing in
+NeuralNetwork.cpp:248. On TPU, intra-step timing belongs to the XLA
+profiler; these host-side timers measure whole steps / phases and feed
+the per-pass report the trainer logs (TrainerInternal.cpp:177 area).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class StatInfo:
+    __slots__ = ("total", "count", "max", "min")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, dt: float):
+        self.total += dt
+        self.count += 1
+        self.max = max(self.max, dt)
+        self.min = min(self.min, dt)
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class StatSet:
+    def __init__(self, name: str = "default"):
+        self.name = name
+        self._stats: dict[str, StatInfo] = {}
+        self._lock = threading.Lock()
+
+    def stat(self, name: str) -> StatInfo:
+        with self._lock:
+            return self._stats.setdefault(name, StatInfo())
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.stat(name).add(time.perf_counter() - t0)
+
+    def report(self) -> str:
+        lines = [f"=== StatSet[{self.name}] ==="]
+        for name in sorted(self._stats):
+            s = self._stats[name]
+            lines.append(
+                f"{name:40s} count={s.count:8d} total={s.total:10.4f}s "
+                f"avg={s.avg * 1e3:9.3f}ms max={s.max * 1e3:9.3f}ms"
+            )
+        return "\n".join(lines)
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+
+GLOBAL_STATS = StatSet("global")
+timer = GLOBAL_STATS.timer

@@ -1,0 +1,12 @@
+"""Layer library. Importing this package registers all layer types."""
+
+from paddle_tpu.layers import (  # noqa: F401
+    base,
+    basic,
+    conv,
+    cost,
+    norm,
+    pool,
+    recurrent,
+    sequence,
+)

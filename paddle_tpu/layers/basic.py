@@ -1,0 +1,361 @@
+"""Core dense layers: data, fc, embedding, mixed-style combinators.
+
+Reference: paddle/gserver/layers/{DataLayer,FullyConnectedLayer,
+TableProjection,AddtoLayer,ConcatenateLayer,CosSimLayer,
+InterpolationLayer,SlopeInterceptLayer,ScalingLayer,DotMulLayer,
+TensorLayer,OuterProdLayer,SelectiveFullyConnectedLayer}.cpp — rebuilt as
+pure jnp functions; matmuls hit the MXU via jnp.dot/einsum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Ctx, Layer, Spec
+
+
+@LAYERS.register("data")
+class DataLayer(Layer):
+    """Input placeholder (gserver/layers/DataLayer.cpp). attrs:
+    is_seq, has_subseq, is_ids, dim (feature shape tuple) or size."""
+
+    def build(self, in_specs):
+        a = self.conf.attrs
+        dim = tuple(a.get("dim", (self.conf.size,)))
+        return (
+            Spec(
+                dim=dim,
+                is_seq=a.get("is_seq", False),
+                has_subseq=a.get("has_subseq", False),
+                is_ids=a.get("is_ids", False),
+            ),
+            {},
+        )
+
+    def forward(self, params, inputs, ctx):
+        raise RuntimeError("data layers are fed, not computed")
+
+
+@LAYERS.register("fc")
+class FCLayer(Layer):
+    """Fully connected: y = act(sum_i x_i @ W_i + b)
+    (gserver/layers/FullyConnectedLayer.cpp). Multiple inputs sum into one
+    output, as in the reference."""
+
+    def build(self, in_specs):
+        out = self.conf.size
+        pcs = {}
+        seq = any(s.is_seq for s in in_specs)
+        for i, s in enumerate(in_specs):
+            pcs[f"w{i}"] = self.weight_conf(i, (s.size, out))
+        b = self.bias_conf((out,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(out,), is_seq=seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        y = None
+        seq_lens = None
+        for i, arg in enumerate(inputs):
+            x = arg.value
+            if arg.is_seq:
+                seq_lens = arg.seq_lens
+            x = x.reshape(x.shape[: 2 if arg.is_seq else 1] + (-1,))
+            t = jnp.dot(x, params[f"w{i}"])
+            y = t if y is None else y + t
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, seq_lens)
+        return Arg(value=y, seq_lens=seq_lens)
+
+
+@LAYERS.register("embedding")
+class EmbeddingLayer(Layer):
+    """Id -> row lookup (the reference's table_projection /
+    TableProjection.cpp over a sparse-update parameter,
+    math/SparseRowMatrix.h). Input must carry ids. The table parameter is
+    marked sparse_update so the optimizer can apply row-sparse updates and
+    the parallel runtime can shard it over the mesh."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        assert s.is_ids, f"embedding layer {self.name} needs an ids input"
+        vocab = self.conf.attrs["vocab_size"]
+        pc = self.weight_conf(0, (vocab, self.conf.size))
+        pc.sparse_update = True
+        return Spec(dim=(self.conf.size,), is_seq=s.is_seq), {"w0": pc}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        y = jnp.take(params["w0"], arg.ids, axis=0)
+        if arg.is_seq:
+            from paddle_tpu.ops.sequence_ops import _mask
+
+            y = y * _mask(arg.seq_lens, y.shape[1], y.dtype)[..., None]
+        return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("addto")
+class AddtoLayer(Layer):
+    """Elementwise sum of same-shaped inputs + bias + activation
+    (gserver/layers/AddtoLayer.cpp)."""
+
+    def build(self, in_specs):
+        s0 = in_specs[0]
+        pcs = {}
+        b = self.bias_conf((s0.size,))
+        if b is not None:
+            pcs["b"] = b
+        return s0, pcs
+
+    def forward(self, params, inputs, ctx):
+        y = inputs[0].value
+        for a in inputs[1:]:
+            y = y + a.value
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, inputs[0].seq_lens)
+        return inputs[0].with_value(y)
+
+
+@LAYERS.register("concat")
+class ConcatLayer(Layer):
+    """Feature-axis concat (gserver/layers/ConcatenateLayer.cpp)."""
+
+    def build(self, in_specs):
+        seq = any(s.is_seq for s in in_specs)
+        tot = sum(s.size for s in in_specs)
+        return Spec(dim=(tot,), is_seq=seq), {}
+
+    def forward(self, params, inputs, ctx):
+        flat = []
+        seq_lens = None
+        for a in inputs:
+            x = a.value
+            if a.is_seq:
+                seq_lens = a.seq_lens
+                x = x.reshape(x.shape[:2] + (-1,))
+            else:
+                x = x.reshape(x.shape[:1] + (-1,))
+            flat.append(x)
+        y = jnp.concatenate(flat, axis=-1)
+        y = self.apply_activation_and_dropout(y, ctx, seq_lens)
+        return Arg(value=y, seq_lens=seq_lens)
+
+
+@LAYERS.register("cos")
+class CosSimLayer(Layer):
+    """Cosine similarity of two inputs, scaled (gserver/layers/CosSimLayer.cpp,
+    function/CosSimOp.cpp). attrs: scale (default 1)."""
+
+    def build(self, in_specs):
+        seq = any(s.is_seq for s in in_specs)
+        return Spec(dim=(1,), is_seq=seq), {}
+
+    def forward(self, params, inputs, ctx):
+        a, b = inputs[0].value, inputs[1].value
+        scale = self.conf.attrs.get("scale", 1.0)
+        eps = 1e-8
+        num = jnp.sum(a * b, axis=-1, keepdims=True)
+        den = jnp.linalg.norm(a, axis=-1, keepdims=True) * jnp.linalg.norm(
+            b, axis=-1, keepdims=True
+        )
+        y = scale * num / jnp.maximum(den, eps)
+        return Arg(value=y, seq_lens=inputs[0].seq_lens)
+
+
+@LAYERS.register("interpolation")
+class InterpolationLayer(Layer):
+    """y = w*x1 + (1-w)*x2 with per-example scalar w
+    (gserver/layers/InterpolationLayer.cpp). inputs: [w(1-dim), x1, x2]."""
+
+    def build(self, in_specs):
+        return in_specs[1], {}
+
+    def forward(self, params, inputs, ctx):
+        w = inputs[0].value
+        x1, x2 = inputs[1].value, inputs[2].value
+        y = w * x1 + (1.0 - w) * x2
+        return inputs[1].with_value(y)
+
+@LAYERS.register("scaling")
+class ScalingLayer(Layer):
+    """y = scalar_input * vector_input (gserver/layers/ScalingLayer.cpp).
+    inputs: [weight(dim 1), x]."""
+
+    def build(self, in_specs):
+        return in_specs[1], {}
+
+    def forward(self, params, inputs, ctx):
+        return inputs[1].with_value(inputs[0].value * inputs[1].value)
+
+
+@LAYERS.register("dot_mul")
+class DotMulLayer(Layer):
+    """Elementwise product of two inputs (DotMulOperator in MixedLayer)."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        y = inputs[0].value * inputs[1].value
+        y = self.apply_activation_and_dropout(y, ctx, inputs[0].seq_lens)
+        return inputs[0].with_value(y)
+
+
+@LAYERS.register("slope_intercept")
+class SlopeInterceptLayer(Layer):
+    """y = slope*x + intercept (gserver/layers/SlopeInterceptLayer.cpp)."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        a = self.conf.attrs
+        y = a.get("slope", 1.0) * inputs[0].value + a.get("intercept", 0.0)
+        return inputs[0].with_value(y)
+
+
+@LAYERS.register("mixed")
+class MixedLayer(Layer):
+    """Sum of projections (gserver/layers/MixedLayer.cpp). Each input edge
+    has attrs["proj"] in {identity, full_matrix, table, dotmul, scaling,
+    trans_full_matrix}; results are summed, then bias+activation — the
+    reference's projection/operator composition model."""
+
+    def build(self, in_specs):
+        out = self.conf.size
+        pcs = {}
+        seq = any(s.is_seq for s in in_specs)
+        for i, (s, ic) in enumerate(zip(in_specs, self.conf.inputs)):
+            proj = ic.attrs.get("proj", "full_matrix")
+            if proj == "full_matrix":
+                pcs[f"w{i}"] = self.weight_conf(i, (s.size, out))
+            elif proj == "trans_full_matrix":
+                pcs[f"w{i}"] = self.weight_conf(i, (out, s.size))
+            elif proj == "table":
+                vocab = ic.attrs["vocab_size"]
+                pc = self.weight_conf(i, (vocab, out))
+                pc.sparse_update = True
+                pcs[f"w{i}"] = pc
+            elif proj == "dotmul":
+                pcs[f"w{i}"] = self.weight_conf(i, (out,))
+            elif proj == "scaling":
+                pcs[f"w{i}"] = self.weight_conf(i, (1,))
+            elif proj == "identity":
+                assert s.size == out, f"identity proj size mismatch on {self.name}"
+            else:
+                raise KeyError(f"unknown projection {proj!r}")
+        b = self.bias_conf((out,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(out,), is_seq=seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        y = None
+        seq_lens = None
+        for i, (a, ic) in enumerate(zip(inputs, self.conf.inputs)):
+            proj = ic.attrs.get("proj", "full_matrix")
+            if a.is_seq:
+                seq_lens = a.seq_lens
+            if proj == "identity":
+                t = a.value
+            elif proj == "full_matrix":
+                t = jnp.dot(a.value, params[f"w{i}"])
+            elif proj == "trans_full_matrix":
+                t = jnp.dot(a.value, params[f"w{i}"].T)
+            elif proj == "table":
+                t = jnp.take(params[f"w{i}"], a.ids, axis=0)
+            elif proj == "dotmul":
+                t = a.value * params[f"w{i}"]
+            elif proj == "scaling":
+                t = a.value * params[f"w{i}"][0]
+            y = t if y is None else y + t
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, seq_lens)
+        return Arg(value=y, seq_lens=seq_lens)
+
+
+@LAYERS.register("tensor")
+class TensorLayer(Layer):
+    """Bilinear tensor product y_k = x1 @ W_k @ x2
+    (gserver/layers/TensorLayer.cpp)."""
+
+    def build(self, in_specs):
+        s1, s2 = in_specs
+        out = self.conf.size
+        pcs = {"w0": self.weight_conf(0, (out, s1.size, s2.size))}
+        b = self.bias_conf((out,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(out,), is_seq=s1.is_seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        x1, x2 = inputs[0].value, inputs[1].value
+        y = jnp.einsum("...i,kij,...j->...k", x1, params["w0"], x2)
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, inputs[0].seq_lens)
+        return inputs[0].with_value(y)
+
+
+@LAYERS.register("outer_prod")
+class OuterProdLayer(Layer):
+    """Outer product of two vectors flattened (OuterProdLayer.cpp)."""
+
+    def build(self, in_specs):
+        s1, s2 = in_specs
+        return Spec(dim=(s1.size * s2.size,), is_seq=s1.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        x1, x2 = inputs[0].value, inputs[1].value
+        y = jnp.einsum("...i,...j->...ij", x1, x2)
+        y = y.reshape(y.shape[:-2] + (-1,))
+        return inputs[0].with_value(y)
+
+
+@LAYERS.register("sum_to_one_norm")
+class SumToOneNormLayer(Layer):
+    """Row-normalize to sum 1 (SumToOneNormLayer.cpp)."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0].value
+        s = jnp.sum(x, axis=-1, keepdims=True)
+        return inputs[0].with_value(x / jnp.where(s == 0, 1.0, s))
+
+
+@LAYERS.register("trans")
+class TransLayer(Layer):
+    """Matrix transpose of the per-example [H,W] view (TransLayer.cpp).
+    attrs: height, width."""
+
+    def build(self, in_specs):
+        h, w = self.conf.attrs["height"], self.conf.attrs["width"]
+        return Spec(dim=(w * h,), is_seq=in_specs[0].is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        h, w = self.conf.attrs["height"], self.conf.attrs["width"]
+        x = inputs[0].value
+        lead = x.shape[:-1]
+        y = x.reshape(lead + (h, w)).swapaxes(-1, -2).reshape(lead + (h * w,))
+        return inputs[0].with_value(y)
+
+
+@LAYERS.register("resize")
+class ResizeLayer(Layer):
+    """Reshape feature dim (ResizeLayer.cpp)."""
+
+    def build(self, in_specs):
+        return Spec(dim=(self.conf.size,), is_seq=in_specs[0].is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0].value
+        lead = 2 if inputs[0].is_seq else 1
+        return inputs[0].with_value(x.reshape(x.shape[:lead] + (self.conf.size,)))

@@ -1,0 +1,127 @@
+"""Convolution layers.
+
+Reference: gserver/layers/{ExpandConvLayer,CudnnConvBaseLayer,ConvTransLayer}
+with im2col+GEMM / cuDNN kernels (function/GemmConvOp.cpp,
+cuda/src/hl_cuda_cudnn.cc). TPU-first: a single `lax.conv_general_dilated`
+in NHWC layout — XLA tiles it straight onto the MXU; no im2col, no backend
+dispatch, grouped/depthwise via feature_group_count
+(function/DepthwiseConvOp.cpp parity).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+def conv_out_size(in_size, filt, stride, pad):
+    return (in_size + 2 * pad - filt) // stride + 1
+
+
+@LAYERS.register("exconv", "cudnn_conv", "conv")
+class ConvLayer(Layer):
+    """2-D convolution. attrs: num_filters (or conf.size used as out dim),
+    filter_size, stride=1, padding=0, groups=1, dilation=1.
+    Input spec dim must be (H, W, C)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        a = self.conf.attrs
+        fh, fw = _pair(a.get("filter_size", 3))
+        sh, sw = _pair(a.get("stride", 1))
+        ph, pw = _pair(a.get("padding", 0))
+        dh, dw = _pair(a.get("dilation", 1))
+        groups = a.get("groups", 1)
+        nf = a.get("num_filters", self.conf.size)
+        oh = conv_out_size(h, dh * (fh - 1) + 1, sh, ph)
+        ow = conv_out_size(w, dw * (fw - 1) + 1, sw, pw)
+        pcs = {"w0": self.weight_conf(0, (fh, fw, c // groups, nf))}
+        if pcs["w0"].initial_std is None:
+            # match reference conv init: std = sqrt(2 / (fan_in))
+            pcs["w0"].initial_std = (2.0 / (fh * fw * c / groups)) ** 0.5
+        b = self.bias_conf((nf,))
+        if b is not None:
+            pcs["b"] = b
+        self._shape = (h, w, c)
+        return Spec(dim=(oh, ow, nf), is_seq=s.is_seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        sh, sw = _pair(a.get("stride", 1))
+        ph, pw = _pair(a.get("padding", 0))
+        dh, dw = _pair(a.get("dilation", 1))
+        groups = a.get("groups", 1)
+        x = arg.value
+        x = x.reshape((x.shape[0],) + self._shape)
+        y = lax.conv_general_dilated(
+            x,
+            params["w0"],
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            rhs_dilation=(dh, dw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32,
+        )
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
+        return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("exconvt", "conv_trans")
+class ConvTransLayer(Layer):
+    """Transposed conv (gserver/layers/ConvTransLayer.cpp et al.)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        a = self.conf.attrs
+        fh, fw = _pair(a.get("filter_size", 3))
+        sh, sw = _pair(a.get("stride", 1))
+        ph, pw = _pair(a.get("padding", 0))
+        nf = a.get("num_filters", self.conf.size)
+        oh = sh * (h - 1) + fh - 2 * ph
+        ow = sw * (w - 1) + fw - 2 * pw
+        pcs = {"w0": self.weight_conf(0, (fh, fw, nf, c))}
+        b = self.bias_conf((nf,))
+        if b is not None:
+            pcs["b"] = b
+        self._shape = (h, w, c)
+        return Spec(dim=(oh, ow, nf), is_seq=s.is_seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        fh, fw = _pair(a.get("filter_size", 3))
+        sh, sw = _pair(a.get("stride", 1))
+        ph, pw = _pair(a.get("padding", 0))
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        # transposed conv as the gradient of conv: input dilation by stride,
+        # spatially-flipped kernel, padding k-1-p. Output (h-1)*s + k - 2p.
+        w = params["w0"]  # (fh, fw, nf, c)
+        w = jnp.flip(w, axis=(0, 1)).transpose(0, 1, 3, 2)  # -> (fh, fw, c, nf)
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=(1, 1),
+            padding=((fh - 1 - ph, fh - 1 - ph), (fw - 1 - pw, fw - 1 - pw)),
+            lhs_dilation=(sh, sw),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32,
+        )
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
+        return Arg(value=y, seq_lens=arg.seq_lens)

@@ -1,0 +1,182 @@
+"""Cost (loss) layers.
+
+Reference: gserver/layers/CostLayer.cpp — MultiClassCrossEntropy,
+SoftBinaryClassCrossEntropy, SumOfSquaresCostLayer, SmoothL1Cost,
+RankingCost, LambdaCost, MultiBinaryLabelCrossEntropy, HuberTwoClass —
+plus the classification_cost composite (softmax + CE) from
+trainer_config_helpers/layers.py. Each outputs per-example cost [B] (or
+masked per-token for sequences); the trainer reduces to the batch cost the
+same way Argument::sum does (TrainerInternal.cpp:135).
+
+For sequence inputs, padding tokens contribute exactly zero cost and the
+per-example cost is the sum over real timesteps — matching the reference's
+padding-free accounting.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+
+_EPS = 1e-10
+
+
+class CostLayerBase(Layer):
+    is_cost = True
+
+    def build(self, in_specs):
+        self._in_specs = in_specs
+        return Spec(dim=(1,), is_seq=False), {}
+
+    def _reduce(self, per_token, arg: Arg):
+        """per_token: [B] (non-seq) or [B,T] (seq) -> per-example [B]."""
+        w = self.conf.attrs.get("coeff", 1.0)
+        if arg.is_seq and per_token.ndim == 2:
+            per_token = per_token * arg.mask(per_token.dtype)
+            per_token = jnp.sum(per_token, axis=1)
+        return Arg(value=w * per_token)
+
+
+@LAYERS.register("multi-class-cross-entropy", "cross_entropy")
+class MultiClassCrossEntropy(CostLayerBase):
+    """-log p[label]; input is a probability distribution (after softmax
+    layer). inputs: [prob, label(ids)]."""
+
+    def forward(self, params, inputs, ctx):
+        prob, label = inputs
+        p = jnp.take_along_axis(
+            prob.value, label.ids[..., None], axis=-1
+        )[..., 0]
+        return self._reduce(-jnp.log(jnp.maximum(p, _EPS)), prob)
+
+
+@LAYERS.register("classification_cost", "softmax_with_cross_entropy")
+class SoftmaxCrossEntropy(CostLayerBase):
+    """Fused softmax+CE on logits — numerically the composite the v1 DSL
+    builds (trainer_config_helpers/layers.py classification_cost), fused
+    for TPU (one logsumexp, no materialized probs)."""
+
+    def forward(self, params, inputs, ctx):
+        logits, label = inputs
+        lse = jax.scipy.special.logsumexp(logits.value, axis=-1)
+        picked = jnp.take_along_axis(
+            logits.value, label.ids[..., None], axis=-1
+        )[..., 0]
+        return self._reduce(lse - picked, logits)
+
+
+@LAYERS.register("square_error", "sum_of_squares", "mse")
+class SumOfSquaresCost(CostLayerBase):
+    """0.5*||x - y||^2 per example (CostLayer.cpp SumOfSquaresCostLayer)."""
+
+    def forward(self, params, inputs, ctx):
+        x, y = inputs
+        d = x.value - y.value
+        return self._reduce(0.5 * jnp.sum(jnp.square(d), axis=-1), x)
+
+
+@LAYERS.register("smooth_l1")
+class SmoothL1Cost(CostLayerBase):
+    """Smooth-L1 (CostLayer.cpp SmoothL1CostLayer)."""
+
+    def forward(self, params, inputs, ctx):
+        x, y = inputs
+        d = jnp.abs(x.value - y.value)
+        per = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return self._reduce(jnp.sum(per, axis=-1), x)
+
+
+@LAYERS.register("soft_binary_class_cross_entropy")
+class SoftBinaryCE(CostLayerBase):
+    """Elementwise binary CE with soft labels (CostLayer.cpp)."""
+
+    def forward(self, params, inputs, ctx):
+        x, y = inputs
+        p = jnp.clip(x.value, _EPS, 1.0 - _EPS)
+        per = -(y.value * jnp.log(p) + (1 - y.value) * jnp.log(1 - p))
+        return self._reduce(jnp.sum(per, axis=-1), x)
+
+
+@LAYERS.register("multi_binary_label_cross_entropy")
+class MultiBinaryLabelCE(CostLayerBase):
+    """Multi-label binary CE; label is a dense 0/1 matrix (the reference
+    accepts sparse binary labels — here densified by the feeder)."""
+
+    forward = SoftBinaryCE.forward
+
+
+@LAYERS.register("rank-cost")
+class RankingCost(CostLayerBase):
+    """Pairwise rank cost (CostLayer.cpp RankingCost): inputs
+    [score_a, score_b, label] with label in [0,1];
+    cost = log(1 + exp(o)) - t*o where o = a - b."""
+
+    def forward(self, params, inputs, ctx):
+        a, b, t = inputs
+        o = (a.value - b.value)[..., 0]
+        label = t.value[..., 0] if t.value is not None else t.ids.astype(o.dtype)
+        per = jnp.logaddexp(0.0, o) - label * o
+        return self._reduce(per, a)
+
+
+@LAYERS.register("huber_classification", "huber-two-class")
+class HuberTwoClassCost(CostLayerBase):
+    """Huber loss for 2-class classification with {-1,1} margin
+    (CostLayer.cpp HuberTwoClassification): input 1-D score, label 0/1."""
+
+    def forward(self, params, inputs, ctx):
+        x, t = inputs
+        y = 2.0 * t.ids.astype(x.value.dtype) - 1.0  # {0,1} -> {-1,1}
+        a = y * x.value[..., 0]
+        per = jnp.where(a < -1.0, -4.0 * a, jnp.where(a < 1.0, jnp.square(1.0 - a), 0.0))
+        return self._reduce(per, x)
+
+
+@LAYERS.register("lambda_cost")
+class LambdaCost(CostLayerBase):
+    """LambdaRank NDCG cost over a sequence of (score, relevance)
+    (CostLayer.cpp LambdaCost). inputs: [score(seq [B,T,1]), rel(seq)].
+    attrs: NDCG_num (default 5), max_sort_size unused (full sort)."""
+
+    def forward(self, params, inputs, ctx):
+        score, rel = inputs
+        s = score.value[..., 0]  # [B,T]
+        r = rel.value[..., 0]
+        mask = score.mask(s.dtype)
+        ninf = jnp.asarray(-1e30, s.dtype)
+        k = self.conf.attrs.get("NDCG_num", 5)
+        t = s.shape[1]
+
+        # ideal DCG from top-k relevances
+        r_masked = jnp.where(mask > 0, r, ninf)
+        r_sorted = -jnp.sort(-r_masked, axis=1)[:, :k]
+        disc = 1.0 / jnp.log2(jnp.arange(2, k + 2, dtype=s.dtype))
+        idcg = jnp.sum((jnp.exp2(jnp.maximum(r_sorted, 0)) - 1) * disc, axis=1)
+        idcg = jnp.maximum(idcg, _EPS)
+
+        # pairwise lambda cost: sum over pairs i<j with r_i != r_j
+        si, sj = s[:, :, None], s[:, None, :]
+        ri, rj = r[:, :, None], r[:, None, :]
+        mij = mask[:, :, None] * mask[:, None, :]
+        hi = (ri > rj).astype(s.dtype)
+        o = si - sj
+        pair_cost = jnp.logaddexp(0.0, -o) / jnp.log(2.0)
+        per = jnp.sum(hi * pair_cost * mij, axis=(1, 2)) / idcg
+        return Arg(value=self.conf.attrs.get("coeff", 1.0) * per)
+
+
+@LAYERS.register("softmax")
+class SoftmaxLayer(Layer):
+    """Standalone softmax output layer (the v1 DSL's `softmax` activation on
+    an fc is more common, but a bare softmax layer type also exists)."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        return arg.with_value(jax.nn.softmax(arg.value, axis=-1))

@@ -1,0 +1,126 @@
+"""Normalization layers.
+
+Reference: gserver/layers/{BatchNormalizationLayer,CudnnBatchNormLayer,
+BatchNormBaseLayer,CrossMapNormalLayer,NormLayer}.cpp (3 batch-norm impls;
+LRN via function/CrossMapNormalOp.cpp). One XLA impl each. Running
+mean/var live in network *state*, not params — they are not differentiated
+(the reference models them as static parameters updated in forward).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import ParameterConf
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+
+
+@LAYERS.register("batch_norm", "cudnn_batch_norm")
+class BatchNormLayer(Layer):
+    """Batch normalization over the channel (last) axis. attrs:
+    moving_average_fraction (default .9, reference
+    BatchNormBaseLayer movingAvgFraction_), epsilon (1e-5),
+    use_global_stats (force inference stats)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        c = s.dim[-1] if len(s.dim) > 1 else s.size
+        self._channels = c
+        pcs = {
+            "w0": self.weight_conf(0, (c,)),
+            "b": self.bias_conf((c,)) or ParameterConf(name=f"_{self.name}.wbias", dims=(c,)),
+        }
+        # scale init = 1 (reference initializes gamma to 1)
+        if pcs["w0"].initial_std is None:
+            pcs["w0"].initial_strategy = "constant"
+            pcs["w0"].initial_value = 1.0
+        self._spec = s
+        return s, pcs
+
+    def init_state(self):
+        c = self._channels
+        return {
+            "mean": jnp.zeros((c,)),
+            "var": jnp.ones((c,)),
+        }
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        eps = a.get("epsilon", 1e-5)
+        frac = a.get("moving_average_fraction", 0.9)
+        use_global = a.get("use_global_stats", False) or not ctx.train
+        x = arg.value
+        st = ctx.state[self.name]
+        red = tuple(range(x.ndim - 1))
+        if use_global:
+            mean, var = st["mean"], st["var"]
+            ctx.updated_state[self.name] = st
+        elif arg.is_seq:
+            # mask padded timesteps out of the statistics: padding must
+            # never affect results (framework invariant; see core/arg.py)
+            m = arg.mask(x.dtype).reshape(x.shape[:2] + (1,) * (x.ndim - 2))
+            n = jnp.maximum(jnp.sum(m), 1.0) * (
+                x.size / (x.shape[0] * x.shape[1] * x.shape[-1])
+            )
+            mean = jnp.sum(x * m, axis=red) / n
+            var = jnp.sum(jnp.square(x - mean) * m, axis=red) / n
+            ctx.updated_state[self.name] = {
+                "mean": st["mean"] * frac + mean * (1 - frac),
+                "var": st["var"] * frac + var * (1 - frac),
+            }
+        else:
+            mean = jnp.mean(x, axis=red)
+            var = jnp.var(x, axis=red)
+            ctx.updated_state[self.name] = {
+                "mean": st["mean"] * frac + mean * (1 - frac),
+                "var": st["var"] * frac + var * (1 - frac),
+            }
+        inv = jnp.reciprocal(jnp.sqrt(var + eps))
+        y = (x - mean) * inv * params["w0"] + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
+        return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("norm", "cmrnorm-projection")
+class CrossMapNormLayer(Layer):
+    """Local response normalization across channels
+    (function/CrossMapNormalOp.cpp): y = x / (1 + alpha/N * sum x^2)^beta
+    over a window of `size` channels. attrs: size, scale (alpha), pow (beta)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        self._spec = s
+        return s, {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        n = a.get("size", 5)
+        alpha = a.get("scale", 1e-4)
+        beta = a.get("pow", 0.75)
+        x = arg.value
+        sq = jnp.square(x)
+        half = n // 2
+        pad_cfg = [(0, 0)] * (x.ndim - 1) + [(half, n - 1 - half)]
+        padded = jnp.pad(sq, pad_cfg)
+        window = sum(
+            padded[..., i : i + x.shape[-1]] for i in range(n)
+        )
+        denom = jnp.power(1.0 + alpha * window, beta)
+        return arg.with_value(x / denom)
+
+
+@LAYERS.register("row_l2_norm")
+class RowL2NormLayer(Layer):
+    """Row-wise L2 normalize (gserver/layers/RowL2NormLayer.cpp)."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0].value
+        n = jnp.linalg.norm(x, axis=-1, keepdims=True)
+        return inputs[0].with_value(x / jnp.maximum(n, 1e-12))

@@ -1,0 +1,155 @@
+"""Spatial pooling layers.
+
+Reference: gserver/layers/{PoolLayer,CudnnPoolLayer,SpatialPyramidPoolLayer,
+MaxOutLayer}.cpp. TPU-first: `lax.reduce_window`, which XLA lowers to
+vectorized windows — one impl for what the reference has three of
+(CPU / CUDA hand kernel / cuDNN).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.layers.conv import _pair, conv_out_size
+
+
+def _pool2d(x, kind, window, stride, pad):
+    kh, kw = window
+    sh, sw = stride
+    ph, pw = pad
+    dims = (1, kh, kw, 1)
+    strides = (1, sh, sw, 1)
+    padding = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    if kind in ("max", "max-projection", "cudnn-max-pool"):
+        init = -jnp.inf
+        y = lax.reduce_window(x, init, lax.max, dims, strides, padding)
+        return y
+    # average pooling, excluding padding from the divisor (cuDNN
+    # avg-pool-exclude-padding semantics, the reference's AvgPooling)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, padding)
+    ones = jnp.ones_like(x[..., :1])
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, padding)
+    return summed / counts
+
+
+@LAYERS.register("pool", "cudnn_pool")
+class PoolLayer(Layer):
+    """attrs: pool_type in {max, avg}, pool_size, stride, padding.
+    Input spec dim (H, W, C)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        a = self.conf.attrs
+        kh, kw = _pair(a.get("pool_size", 2))
+        sh, sw = _pair(a.get("stride", a.get("pool_size", 2)))
+        ph, pw = _pair(a.get("padding", 0))
+        oh = conv_out_size(h, kh, sh, ph)
+        ow = conv_out_size(w, kw, sw, pw)
+        self._shape = (h, w, c)
+        return Spec(dim=(oh, ow, c), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        kind = a.get("pool_type", "max")
+        window = _pair(a.get("pool_size", 2))
+        stride = _pair(a.get("stride", a.get("pool_size", 2)))
+        pad = _pair(a.get("padding", 0))
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        y = _pool2d(x, kind, window, stride, pad)
+        return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("maxout")
+class MaxOutLayer(Layer):
+    """Max over `groups` channels (gserver/layers/MaxOutLayer.cpp)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        g = self.conf.attrs["groups"]
+        self._shape = (h, w, c)
+        return Spec(dim=(h, w, c // g), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        g = self.conf.attrs["groups"]
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        b, h, w, c = x.shape
+        y = x.reshape(b, h, w, c // g, g).max(axis=-1)
+        return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("spp")
+class SpatialPyramidPoolLayer(Layer):
+    """SPP (gserver/layers/SpatialPyramidPoolLayer.cpp): pyramid of
+    pool levels concat'd to a fixed-length vector. attrs: pyramid_height,
+    pool_type."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        ph = self.conf.attrs.get("pyramid_height", 3)
+        total = sum((2**l) * (2**l) for l in range(ph)) * c
+        self._shape = (h, w, c)
+        return Spec(dim=(total,), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        ph = self.conf.attrs.get("pyramid_height", 3)
+        kind = self.conf.attrs.get("pool_type", "max")
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        b, h, w, c = x.shape
+        outs = []
+        for l in range(ph):
+            bins = 2**l
+            kh, kw = -(-h // bins), -(-w // bins)  # ceil
+            sh, sw = kh, kw
+            pad_h, pad_w = (kh * bins - h + 1) // 2, (kw * bins - w + 1) // 2
+            y = _pool2d(x, kind, (kh, kw), (sh, sw), (pad_h, pad_w))
+            outs.append(y.reshape(b, -1))
+        return Arg(value=jnp.concatenate(outs, axis=-1), seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("blockexpand", "block_expand")
+class BlockExpandLayer(Layer):
+    """Image -> sequence of patches (gserver/layers/BlockExpandLayer.cpp,
+    function/BlockExpandOp.cpp): each output timestep is one [bh*bw*C]
+    block, scanned row-major."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        a = self.conf.attrs
+        bh, bw = _pair(a["block"])
+        sh, sw = _pair(a.get("stride", a["block"]))
+        ph, pw = _pair(a.get("padding", 0))
+        oh = conv_out_size(h, bh, sh, ph)
+        ow = conv_out_size(w, bw, sw, pw)
+        self._shape = (h, w, c)
+        self._steps = oh * ow
+        return Spec(dim=(bh * bw * c,), is_seq=True), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        bh, bw = _pair(a["block"])
+        sh, sw = _pair(a.get("stride", a["block"]))
+        ph, pw = _pair(a.get("padding", 0))
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        patches = lax.conv_general_dilated_patches(
+            x,
+            filter_shape=(bh, bw),
+            window_strides=(sh, sw),
+            padding=((ph, ph), (pw, pw)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # [B, OH, OW, bh*bw*C]
+        b = patches.shape[0]
+        seq = patches.reshape(b, self._steps, -1)
+        lens = jnp.full((b,), self._steps, jnp.int32)
+        return Arg(value=seq, seq_lens=lens)

@@ -1,0 +1,224 @@
+"""Sequence-structure layers.
+
+Reference: gserver/layers/{SequencePoolLayer,SequenceLastInstanceLayer,
+ExpandLayer,SequenceConcatLayer,SequenceReshapeLayer,SeqSliceLayer,
+SequenceReverseLayer,SubSequenceLayer,FirstSeqLayer,...}.cpp. All are mask
+semantics over dense [B,T,...] (see ops/sequence_ops.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.ops import sequence_ops as sops
+
+
+@LAYERS.register("seqpool", "sequence_pool")
+class SequencePoolLayer(Layer):
+    """Pool a sequence to one vector per example, or each sub-sequence to
+    one timestep. attrs: pool_type in {sum, average, max, sqrt_average},
+    level ("seq"->[B,D], "subseq"->[B,S,D])."""
+
+    _OPS = {
+        "sum": sops.seq_sum,
+        "average": sops.seq_avg,
+        "avg": sops.seq_avg,
+        "sqrt_average": sops.seq_sqrt_avg,
+        "max": sops.seq_max,
+    }
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        level = self.conf.attrs.get("level", "seq")
+        if level == "subseq":
+            assert s.has_subseq
+            return Spec(dim=s.dim, is_seq=True), {}
+        return Spec(dim=s.dim), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        kind = self.conf.attrs.get("pool_type", "sum")
+        level = self.conf.attrs.get("level", "seq")
+        if level == "subseq":
+            op_map = {
+                "sum": "sum", "average": "avg", "avg": "avg", "max": "max",
+                "sqrt_average": "sqrt_avg", "last": "last", "first": "first",
+            }
+            if kind not in op_map:
+                raise KeyError(
+                    f"seqpool {self.name}: pool_type {kind!r} not supported at "
+                    f"subseq level (supported: {sorted(op_map)})"
+                )
+            y = sops.subseq_pool(arg.value, arg.subseq_lens, op_map[kind])
+            lens = jnp.sum((arg.subseq_lens > 0).astype(jnp.int32), axis=1)
+            return Arg(value=y, seq_lens=lens)
+        y = self._OPS[kind](arg.value, arg.seq_lens)
+        return Arg(value=y)
+
+
+@LAYERS.register("seqlastins", "last_seq")
+class SequenceLastInstanceLayer(Layer):
+    """Last (or first) real timestep (SequenceLastInstanceLayer.cpp).
+    attrs: select_first."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        return Spec(dim=s.dim), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        if self.conf.attrs.get("select_first", False):
+            y = sops.seq_first(arg.value, arg.seq_lens)
+        else:
+            y = sops.seq_last(arg.value, arg.seq_lens)
+        return Arg(value=y)
+
+
+@LAYERS.register("expand")
+class ExpandLayer(Layer):
+    """Broadcast a [B,D] vector along the time axis of a reference sequence
+    (ExpandLayer.cpp). inputs: [x, seq_ref]."""
+
+    def build(self, in_specs):
+        x, ref = in_specs
+        return Spec(dim=x.dim, is_seq=True), {}
+
+    def forward(self, params, inputs, ctx):
+        x, ref = inputs
+        t = ref.max_len
+        y = sops.expand_to_seq(x.value, ref.seq_lens, t)
+        return Arg(value=y, seq_lens=ref.seq_lens)
+
+
+@LAYERS.register("seqconcat")
+class SequenceConcatLayer(Layer):
+    """Concat two sequences along time, per example (SequenceConcatLayer.cpp)."""
+
+    def build(self, in_specs):
+        a, b = in_specs
+        return Spec(dim=a.dim, is_seq=True), {}
+
+    def forward(self, params, inputs, ctx):
+        a, b = inputs
+        y, lens = sops.seq_concat(a.value, a.seq_lens, b.value, b.seq_lens)
+        return Arg(value=y, seq_lens=lens)
+
+
+@LAYERS.register("seqreshape")
+class SequenceReshapeLayer(Layer):
+    """Reshape [B,T,D] -> [B,T*D/newD,newD] keeping token count
+    (SequenceReshapeLayer.cpp). Requires lengths divisible in the same
+    proportion; padding stays padding."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        return Spec(dim=(self.conf.size,), is_seq=True), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        b, t, d = arg.value.shape
+        nd = self.conf.size
+        nt = t * d // nd
+        y = arg.value.reshape(b, nt, nd)
+        lens = arg.seq_lens * d // nd
+        return Arg(value=y, seq_lens=lens)
+
+
+@LAYERS.register("seqreverse", "sequence_reverse")
+class SequenceReverseLayer(Layer):
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        return arg.with_value(sops.reverse_seq(arg.value, arg.seq_lens))
+
+
+@LAYERS.register("slice", "seq_slice")
+class SeqSliceLayer(Layer):
+    """Static time-window slice (SeqSliceLayer.cpp static case).
+    attrs: begin, size."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        return Spec(dim=s.dim, is_seq=True), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        y, lens = sops.seq_slice_window(arg.value, arg.seq_lens, a["begin"], a["size"])
+        return Arg(value=y, seq_lens=lens)
+
+
+@LAYERS.register("padding", "pad")
+class PadLayer(Layer):
+    """Zero-pad spatial dims of an image input (gserver/layers/PadLayer.cpp,
+    function/PadOp.cpp). attrs: pad_c/pad_h/pad_w as (before, after)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        a = self.conf.attrs
+        pc = tuple(a.get("pad_c", (0, 0)))
+        ph = tuple(a.get("pad_h", (0, 0)))
+        pw = tuple(a.get("pad_w", (0, 0)))
+        self._shape = (h, w, c)
+        self._pads = (ph, pw, pc)
+        return Spec(dim=(h + sum(ph), w + sum(pw), c + sum(pc)), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        ph, pw, pc = self._pads
+        y = jnp.pad(x, ((0, 0), ph, pw, pc))
+        return arg.with_value(y)
+
+
+@LAYERS.register("crop")
+class CropLayer(Layer):
+    """Crop spatial dims (gserver/layers/CropLayer.cpp, function/CropOp.cpp).
+    attrs: crop_h/crop_w (begin, size) or target taken from 2nd input."""
+
+    def build(self, in_specs):
+        s = in_specs[0]
+        h, w, c = s.dim
+        a = self.conf.attrs
+        if len(in_specs) > 1:
+            th, tw, _ = in_specs[1].dim
+            bh = a.get("offset_h", (h - th) // 2)
+            bw = a.get("offset_w", (w - tw) // 2)
+            self._crop = (bh, th, bw, tw)
+        else:
+            bh, th = a["crop_h"]
+            bw, tw = a["crop_w"]
+            self._crop = (bh, th, bw, tw)
+        self._shape = (h, w, c)
+        return Spec(dim=(self._crop[1], self._crop[3], c), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        arg = inputs[0]
+        bh, th, bw, tw = self._crop
+        x = arg.value.reshape((arg.value.shape[0],) + self._shape)
+        return arg.with_value(x[:, bh : bh + th, bw : bw + tw, :])
+
+
+@LAYERS.register("rotate")
+class RotateLayer(Layer):
+    """Rotate the [H,W] view 90° CCW (gserver/layers/RotateLayer.cpp).
+    attrs: height, width."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        a = self.conf.attrs
+        h, w = a["height"], a["width"]
+        x = arg.value
+        lead = x.shape[:-1]
+        y = x.reshape(lead + (h, w))
+        y = jnp.flip(y.swapaxes(-1, -2), axis=-2)
+        return arg.with_value(y.reshape(lead + (h * w,)))

@@ -1,0 +1,140 @@
+"""Network: config -> executable pure functions.
+
+The analogue of the reference's NeuralNetwork gradient machine
+(gserver/gradientmachines/NeuralNetwork.cpp:68,235,285): build layers from
+ModelConf, walk them in topological order for forward. There is no
+hand-written backward walk — `loss_fn` is differentiated with jax.grad and
+the whole step jit-compiles to one XLA program (the TPU-idiomatic
+equivalent of forward+backward+update fusion).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import ModelConf, ParameterConf
+from paddle_tpu.layers.base import Ctx, create_layer, init_parameter
+
+# ensure all layer types are registered
+import paddle_tpu.layers  # noqa: F401
+
+
+class Network:
+    def __init__(self, conf: ModelConf):
+        self.conf = conf
+        self.layers = {}
+        self.specs = {}
+        self.param_confs: dict[str, ParameterConf] = {}  # global name -> conf
+        self.layer_params: dict[str, dict] = {}  # layer -> {slot: global name}
+        self._stateful: dict[str, object] = {}
+        order = []
+        for lc in conf.layers:
+            layer = create_layer(lc, conf)
+            self.layers[lc.name] = layer
+            for n in lc.input_names():
+                if n not in self.specs:
+                    raise KeyError(
+                        f"layer {lc.name!r} input {n!r} is not defined above it "
+                        f"(layers must be in topological order)"
+                    )
+            in_specs = [self.specs[n] for n in lc.input_names()]
+            spec, pcs = layer.build(in_specs)
+            self.specs[lc.name] = spec
+            slot_map = {}
+            for slot, pc in pcs.items():
+                if pc is None:
+                    continue
+                if pc.name in self.param_confs:
+                    # shared parameter: dims must agree
+                    prev = self.param_confs[pc.name]
+                    assert tuple(prev.dims) == tuple(pc.dims), (
+                        f"shared param {pc.name} dim mismatch"
+                    )
+                else:
+                    self.param_confs[pc.name] = pc
+                slot_map[slot] = pc.name
+            self.layer_params[lc.name] = slot_map
+            if hasattr(layer, "init_state"):
+                self._stateful[lc.name] = layer
+            order.append(lc.name)
+        self.order = order
+        self.output_names = list(conf.output_layer_names) or (
+            [order[-1]] if order else []
+        )
+        self.cost_names = [
+            n for n in order if getattr(self.layers[n], "is_cost", False)
+        ]
+        self.input_names = list(conf.input_layer_names) or [
+            lc.name for lc in conf.layers if lc.type == "data"
+        ]
+
+    # ---- parameters & state ----
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> dict:
+        params = {}
+        names = sorted(self.param_confs)
+        keys = jax.random.split(key, max(len(names), 1))
+        for k, name in zip(keys, names):
+            params[name] = init_parameter(k, self.param_confs[name], dtype)
+        return params
+
+    def init_state(self) -> dict:
+        return {name: layer.init_state() for name, layer in self._stateful.items()}
+
+    def _layer_param_view(self, name: str, params: dict) -> dict:
+        return {slot: params[g] for slot, g in self.layer_params[name].items()}
+
+    # ---- execution ----
+    def forward(
+        self,
+        params: dict,
+        feed: dict,
+        *,
+        state: Optional[dict] = None,
+        train: bool = False,
+        rng: Optional[jax.Array] = None,
+    ):
+        """Run all layers. Returns (outputs: {layer_name: Arg}, new_state).
+
+        `feed` maps data-layer names to Arg. Mirrors NeuralNetwork::forward
+        (NeuralNetwork.cpp:235) with passType train/test folded into
+        Ctx.train."""
+        if state is None:
+            state = self.init_state()
+        ctx = Ctx(train=train, rng=rng, state=state)
+        outs: dict[str, Arg] = {}
+        needed = {
+            n for lc in self.conf.layers for n in lc.input_names()
+        }
+        for name in self.order:
+            lc = self.conf.layer(name)
+            if lc.type == "data":
+                if name in feed:
+                    outs[name] = feed[name]
+                elif name in needed:
+                    raise KeyError(
+                        f"data layer {name!r} is consumed by the network but "
+                        f"missing from feed (fed: {sorted(feed)})"
+                    )
+                continue
+            inputs = [outs[n] for n in lc.input_names()]
+            layer_params = self._layer_param_view(name, params)
+            outs[name] = self.layers[name].forward(layer_params, inputs, ctx)
+        new_state = {**ctx.state, **ctx.updated_state}
+        return outs, new_state
+
+    def loss_fn(self, params, feed, state=None, train=True, rng=None):
+        """Scalar batch-mean cost over all cost layers — what
+        TrainerInternal reduces via Argument::sum (TrainerInternal.cpp:135).
+        Returns (loss, (outputs, new_state))."""
+        outs, new_state = self.forward(
+            params, feed, state=state, train=train, rng=rng
+        )
+        assert self.cost_names, "network has no cost layer"
+        total = 0.0
+        for n in self.cost_names:
+            total = total + jnp.mean(outs[n].value)
+        return total, (outs, new_state)

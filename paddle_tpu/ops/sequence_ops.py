@@ -1,0 +1,140 @@
+"""Sequence kernels over dense-packed [B, T, ...] batches.
+
+Capability parity with the reference's sequence machinery — CUDA sequence
+scatter/gather (paddle/cuda/src/hl_cuda_sequence.cu), sequence-aware layers
+(SequencePoolLayer, SequenceLastInstanceLayer, ExpandLayer, ...), and
+SequenceToBatch reordering (paddle/gserver/layers/SequenceToBatch.h) — but in
+mask semantics on static shapes: every op takes [B, T, ...] plus seq_lens [B]
+and guarantees padding positions never affect results.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _mask(seq_lens: jax.Array, t: int, dtype=jnp.float32) -> jax.Array:
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    return (pos < seq_lens[:, None]).astype(dtype)
+
+
+def seq_sum(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """[B,T,D] -> [B,D] sum over real timesteps."""
+    m = _mask(seq_lens, x.shape[1], x.dtype)
+    return jnp.einsum("bt,bt...->b...", m, x)
+
+
+def seq_avg(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    denom = jnp.maximum(seq_lens, 1).astype(x.dtype)
+    return seq_sum(x, seq_lens) / denom[:, None]
+
+
+def seq_sqrt_avg(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """sum / sqrt(len) — the reference's "SqrtAvgPooling"."""
+    denom = jnp.sqrt(jnp.maximum(seq_lens, 1).astype(x.dtype))
+    return seq_sum(x, seq_lens) / denom[:, None]
+
+
+def seq_max(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    m = _mask(seq_lens, x.shape[1], x.dtype)
+    neg = jnp.asarray(NEG_INF, x.dtype)
+    masked = jnp.where(m[..., None] > 0, x, neg)
+    return jnp.max(masked, axis=1)
+
+
+def seq_last(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """[B,T,D] -> [B,D] value at t = len-1 (SequenceLastInstanceLayer)."""
+    idx = jnp.maximum(seq_lens - 1, 0)
+    return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+
+
+def seq_first(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    del seq_lens
+    return x[:, 0]
+
+
+def expand_to_seq(x: jax.Array, seq_lens: jax.Array, t: int) -> jax.Array:
+    """[B,D] -> [B,T,D] broadcast along time (ExpandLayer)."""
+    out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+    return out * _mask(seq_lens, t, x.dtype)[(...,) + (None,) * (x.ndim - 1)]
+
+
+def masked_softmax(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """Softmax over the time axis of [B,T] with padding masked out
+    (the reference's sequence_softmax activation)."""
+    m = _mask(seq_lens, x.shape[1], x.dtype)
+    z = jnp.where(m > 0, x, jnp.asarray(NEG_INF, x.dtype))
+    p = jax.nn.softmax(z, axis=1)
+    return p * m
+
+
+def reverse_seq(x: jax.Array, seq_lens: jax.Array) -> jax.Array:
+    """Reverse each sequence in place, keeping padding at the tail
+    (reference: SequenceReverseLayer / reversed recurrent groups)."""
+    t = x.shape[1]
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    src = jnp.where(pos < seq_lens[:, None], seq_lens[:, None] - 1 - pos, pos)
+    return jnp.take_along_axis(x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1)
+
+
+def seq_concat(a, a_lens, b, b_lens):
+    """Concatenate two sequence batches along time per-row
+    (SequenceConcatLayer). Output time dim = Ta + Tb (static)."""
+    ta, tb = a.shape[1], b.shape[1]
+    t_out = ta + tb
+    out_lens = a_lens + b_lens
+    pos = jnp.arange(t_out, dtype=jnp.int32)[None, :]  # [1, T_out]
+    from_a = pos < a_lens[:, None]
+    a_idx = jnp.clip(pos, 0, ta - 1)
+    b_idx = jnp.clip(pos - a_lens[:, None], 0, tb - 1)
+    extra = (1,) * (a.ndim - 2)
+    a_gath = jnp.take_along_axis(a, a_idx.reshape(a_idx.shape + extra), axis=1)
+    b_gath = jnp.take_along_axis(b, b_idx.reshape(b_idx.shape + extra), axis=1)
+    valid = pos < out_lens[:, None]
+    out = jnp.where(from_a.reshape(from_a.shape + extra), a_gath, b_gath)
+    return out * valid.reshape(valid.shape + extra).astype(out.dtype), out_lens
+
+
+def seq_slice_window(x, seq_lens, begin: int, size: int):
+    """Static window slice along time (SeqSliceLayer, static case)."""
+    sl = jnp.clip(seq_lens - begin, 0, size)
+    return x[:, begin : begin + size], sl
+
+
+def subseq_to_seq_lens(subseq_lens: jax.Array) -> jax.Array:
+    """[B,S] nested lengths -> [B] total lengths."""
+    return jnp.sum(subseq_lens, axis=1)
+
+
+def subseq_pool(x, subseq_lens, op: str = "sum"):
+    """Pool each sub-sequence: [B,T,D] + [B,S] -> [B,S,D], where the s-th
+    output row pools x[t] for t in the s-th sub-sequence (the reference's
+    sub-sequence pooling used by nested RecurrentGradientMachine,
+    parameter/Argument.h:93 subSequenceStartPositions)."""
+    b, t = x.shape[0], x.shape[1]
+    s = subseq_lens.shape[1]
+    ends = jnp.cumsum(subseq_lens, axis=1)  # [B,S]
+    starts = ends - subseq_lens
+    pos = jnp.arange(t, dtype=jnp.int32)[None, None, :]  # [1,1,T]
+    inside = (pos >= starts[..., None]) & (pos < ends[..., None])  # [B,S,T]
+    inside_f = inside.astype(x.dtype)
+    if op == "sum":
+        return jnp.einsum("bst,btd->bsd", inside_f, x)
+    if op == "avg":
+        denom = jnp.maximum(subseq_lens, 1).astype(x.dtype)[..., None]
+        return jnp.einsum("bst,btd->bsd", inside_f, x) / denom
+    if op == "sqrt_avg":
+        denom = jnp.sqrt(jnp.maximum(subseq_lens, 1).astype(x.dtype))[..., None]
+        return jnp.einsum("bst,btd->bsd", inside_f, x) / denom
+    if op == "max":
+        big = jnp.where(inside[..., None], x[:, None], jnp.asarray(NEG_INF, x.dtype))
+        return jnp.max(big, axis=2)
+    if op == "last":
+        idx = jnp.maximum(ends - 1, 0)  # [B,S]
+        return jnp.take_along_axis(x, idx[..., None], axis=1)
+    if op == "first":
+        return jnp.take_along_axis(x, starts[..., None], axis=1)
+    raise ValueError(f"unknown subseq pool op {op!r}")

@@ -1,0 +1,311 @@
+"""Optimizers, LR schedulers, regularizers, parameter averaging.
+
+Reference equations: paddle/parameter/FirstOrderOptimizer.h:23-320 and the
+fused kernels in paddle/math/TrainingAlgorithmOp.h:38-114 (sgdUpdate,
+adagradApply, adadeltaApply, rmspropApply, decayedAdagradApply, adamApply,
+adamaxApply); schedulers: paddle/parameter/LearningRateScheduler.cpp:50-172;
+regularizers: paddle/parameter/Regularizer.h; averaging:
+paddle/parameter/AverageOptimizer.h.
+
+TPU-first: one functional `update(grads, params, state, step)` jit-compiled
+and shardable with the params; no per-block pserver traversal — the
+optimizer runs sharded on-device under pjit (replacing
+ParameterServer2::blockTraverse, pserver/ParameterServer2.h:637).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.config import OptimizationConf, ParameterConf
+from paddle_tpu.core.registry import LR_SCHEDULERS, OPTIMIZERS
+
+
+# ---------------- learning-rate schedulers ----------------
+# reference: parameter/LearningRateScheduler.cpp:50-172
+
+def _sched_constant(conf: OptimizationConf, step):
+    return 1.0
+
+
+def _sched_poly(conf, step):
+    # lr * (1 + a*t)^(-b)
+    t = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+    return jnp.power(1.0 + conf.learning_rate_decay_a * t, -conf.learning_rate_decay_b)
+
+
+def _sched_exp(conf, step):
+    # lr * a^(t/b)
+    t = step
+    return jnp.power(conf.learning_rate_decay_a, t / conf.learning_rate_decay_b)
+
+
+def _sched_discexp(conf, step):
+    # lr * a^floor(t/b)
+    t = step
+    return jnp.power(
+        conf.learning_rate_decay_a, jnp.floor(t / conf.learning_rate_decay_b)
+    )
+
+
+def _sched_linear(conf, step):
+    # max(lr - a*t, b) / lr  (linear_decay in reference returns absolute)
+    lr = conf.learning_rate
+    return jnp.maximum(lr - conf.learning_rate_decay_a * step, conf.learning_rate_decay_b) / lr
+
+
+for _n, _f in [
+    ("constant", _sched_constant),
+    ("poly", _sched_poly),
+    ("exp", _sched_exp),
+    ("discexp", _sched_discexp),
+    ("linear", _sched_linear),
+]:
+    LR_SCHEDULERS.register(_n)(type("S_" + _n, (), {"fn": staticmethod(_f)}))
+
+
+def lr_at(conf: OptimizationConf, step) -> jax.Array:
+    """Effective learning rate at `step` (num samples processed in the
+    reference's pass-scale scheduling; we use batch steps)."""
+    sched = LR_SCHEDULERS.get(conf.learning_rate_schedule).fn
+    step = jnp.asarray(step, jnp.float32)
+    return conf.learning_rate * sched(conf, step)
+
+
+# ---------------- per-parameter static hyperparams ----------------
+
+@dataclass(frozen=True)
+class ParamHyper:
+    lr_mult: float = 1.0
+    l1: float = 0.0
+    l2: float = 0.0
+    clip: float = 0.0  # per-parameter clip threshold
+    is_static: bool = False
+    momentum: Optional[float] = None
+
+
+def hyper_from_conf(pc: ParameterConf, opt: OptimizationConf) -> ParamHyper:
+    return ParamHyper(
+        lr_mult=pc.learning_rate,
+        l1=pc.decay_rate_l1 if pc.decay_rate_l1 is not None else opt.l1_rate,
+        l2=pc.decay_rate if pc.decay_rate is not None else opt.l2_rate,
+        clip=pc.gradient_clipping_threshold or opt.gradient_clipping_threshold,
+        is_static=pc.is_static,
+        momentum=pc.momentum,
+    )
+
+
+# ---------------- optimizer base ----------------
+
+class Optimizer:
+    """Functional optimizer. State is a pytree parallel to params."""
+
+    name = None
+
+    def __init__(self, conf: OptimizationConf, hypers: dict):
+        self.conf = conf
+        self.hypers = hypers  # param name -> ParamHyper
+
+    def init_state(self, params: dict) -> dict:
+        return {k: self._init_one(v) for k, v in params.items()}
+
+    def update(self, grads: dict, params: dict, state: dict, step) -> tuple:
+        """Returns (new_params, new_state). `step` is the global batch
+        counter (0-based)."""
+        lr = lr_at(self.conf, step)
+        new_p, new_s = {}, {}
+        for k, p in params.items():
+            h = self.hypers.get(k, ParamHyper())
+            g = grads.get(k)
+            if g is None or h.is_static:
+                new_p[k], new_s[k] = p, state[k]
+                continue
+            if h.clip > 0.0:
+                g = jnp.clip(g, -h.clip, h.clip)
+            # L2 decay folded into gradient (reference applies decay in the
+            # update kernels, TrainingAlgorithmOp.h sgdUpdate decayRate)
+            if h.l2 > 0.0:
+                g = g + h.l2 * p
+            np_, ns_ = self._apply_one(p, g, state[k], lr * h.lr_mult, h, step)
+            # L1: proximal shrinkage after the step (reference
+            # applyL1 in Regularizer)
+            if h.l1 > 0.0:
+                shrink = lr * h.lr_mult * h.l1
+                np_ = jnp.sign(np_) * jnp.maximum(jnp.abs(np_) - shrink, 0.0)
+            new_p[k], new_s[k] = np_, ns_
+        return new_p, new_s
+
+    def _init_one(self, p):
+        raise NotImplementedError
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        raise NotImplementedError
+
+
+@OPTIMIZERS.register("sgd", "momentum")
+class SgdOptimizer(Optimizer):
+    """SGD + (optionally Nesterov) momentum
+    (TrainingAlgorithmOp.h sgdUpdate, FirstOrderOptimizer.h SgdOptimizer)."""
+
+    def _init_one(self, p):
+        return {"mom": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        mu = h.momentum if h.momentum is not None else self.conf.momentum
+        v = mu * s["mom"] - lr * g
+        if self.conf.use_nesterov:
+            p_new = p + mu * v - lr * g
+        else:
+            p_new = p + v
+        return p_new, {"mom": v}
+
+
+@OPTIMIZERS.register("adagrad")
+class AdagradOptimizer(Optimizer):
+    """accum += g^2; p -= lr * g / (sqrt(accum) + eps)
+    (TrainingAlgorithmOp.h adagradApply)."""
+
+    def _init_one(self, p):
+        return {"accum": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        accum = s["accum"] + jnp.square(g)
+        p_new = p - lr * g / (jnp.sqrt(accum) + self.conf.ada_epsilon)
+        return p_new, {"accum": accum}
+
+
+@OPTIMIZERS.register("decayed_adagrad")
+class DecayedAdagradOptimizer(Optimizer):
+    """accum = rou*accum + (1-rou)*g^2 (TrainingAlgorithmOp.h
+    decayedAdagradApply)."""
+
+    def _init_one(self, p):
+        return {"accum": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        rou = self.conf.ada_rou
+        accum = rou * s["accum"] + (1 - rou) * jnp.square(g)
+        p_new = p - lr * g / (jnp.sqrt(accum) + self.conf.ada_epsilon)
+        return p_new, {"accum": accum}
+
+
+@OPTIMIZERS.register("adadelta")
+class AdadeltaOptimizer(Optimizer):
+    """(TrainingAlgorithmOp.h adadeltaApply)."""
+
+    def _init_one(self, p):
+        return {"accum": jnp.zeros_like(p), "accum_update": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        rou, eps = self.conf.ada_rou, self.conf.ada_epsilon
+        accum = rou * s["accum"] + (1 - rou) * jnp.square(g)
+        upd = g * jnp.sqrt((s["accum_update"] + eps) / (accum + eps))
+        accum_update = rou * s["accum_update"] + (1 - rou) * jnp.square(upd)
+        return p - lr * upd, {"accum": accum, "accum_update": accum_update}
+
+
+@OPTIMIZERS.register("rmsprop")
+class RMSPropOptimizer(Optimizer):
+    """g_accum = rou*g_accum + (1-rou)*g^2, with mean-removal term as in
+    TrainingAlgorithmOp.h rmspropApply (tracks E[g] too)."""
+
+    def _init_one(self, p):
+        return {"g2": jnp.zeros_like(p), "g1": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        rou, eps = self.conf.ada_rou, self.conf.ada_epsilon
+        g2 = rou * s["g2"] + (1 - rou) * jnp.square(g)
+        g1 = rou * s["g1"] + (1 - rou) * g
+        denom = jnp.sqrt(g2 - jnp.square(g1) + eps)
+        return p - lr * g / denom, {"g2": g2, "g1": g1}
+
+
+@OPTIMIZERS.register("adam")
+class AdamOptimizer(Optimizer):
+    """(TrainingAlgorithmOp.h adamApply; FirstOrderOptimizer.h AdamOptimizer)."""
+
+    def _init_one(self, p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        b1, b2, eps = self.conf.adam_beta1, self.conf.adam_beta2, self.conf.adam_epsilon
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        m = b1 * s["m"] + (1 - b1) * g
+        v = b2 * s["v"] + (1 - b2) * jnp.square(g)
+        mhat = m / (1 - jnp.power(b1, t))
+        vhat = v / (1 - jnp.power(b2, t))
+        return p - lr * mhat / (jnp.sqrt(vhat) + eps), {"m": m, "v": v}
+
+
+@OPTIMIZERS.register("adamax")
+class AdamaxOptimizer(Optimizer):
+    """(TrainingAlgorithmOp.h adamaxApply)."""
+
+    def _init_one(self, p):
+        return {"m": jnp.zeros_like(p), "u": jnp.zeros_like(p)}
+
+    def _apply_one(self, p, g, s, lr, h, step):
+        b1, b2 = self.conf.adam_beta1, self.conf.adam_beta2
+        t = jnp.asarray(step, jnp.float32) + 1.0
+        m = b1 * s["m"] + (1 - b1) * g
+        u = jnp.maximum(b2 * s["u"], jnp.abs(g))
+        p_new = p - (lr / (1 - jnp.power(b1, t))) * m / (u + 1e-12)
+        return p_new, {"m": m, "u": u}
+
+
+# ---------------- parameter averaging ----------------
+
+@dataclass
+class AverageState:
+    """Sliding parameter average (parameter/AverageOptimizer.h): keeps
+    sum of recent params; `apply` swaps in the average for test, `restore`
+    swaps back — we keep it functional: average() returns averaged params."""
+
+    accum: dict
+    count: int = 0
+
+
+class ParameterAverager:
+    """Sliding average via windowed restart: the accumulator is reset
+    whenever it covers more than `window * total_updates` (capped at
+    `max_window`) updates, so `average()` reflects recent parameters —
+    matching AverageOptimizer's bounded-window intent."""
+
+    def __init__(self, window: float, max_window: int):
+        self.window = window
+        self.max_window = max_window
+        self._total = 0
+
+    def init(self, params):
+        return AverageState(
+            accum=jax.tree_util.tree_map(jnp.zeros_like, params), count=0
+        )
+
+    def accumulate(self, st: AverageState, params) -> AverageState:
+        self._total += 1
+        limit = self.window * self._total if self.window > 0 else float("inf")
+        if self.max_window > 0:
+            limit = min(limit, self.max_window)
+        if st.count >= max(limit, 1):
+            st = AverageState(
+                accum=jax.tree_util.tree_map(jnp.zeros_like, st.accum), count=0
+            )
+        return AverageState(
+            accum=jax.tree_util.tree_map(lambda a, p: a + p, st.accum, params),
+            count=st.count + 1,
+        )
+
+    def average(self, st: AverageState, params):
+        if st.count == 0:
+            return params
+        return jax.tree_util.tree_map(lambda a: a / st.count, st.accum)
+
+
+def create_optimizer(conf: OptimizationConf, param_confs: dict) -> Optimizer:
+    hypers = {k: hyper_from_conf(pc, conf) for k, pc in param_confs.items()}
+    cls = OPTIMIZERS.get(conf.learning_method)
+    return cls(conf, hypers)

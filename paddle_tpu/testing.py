@@ -1,0 +1,175 @@
+"""Test utilities: numeric gradient checking and random batch builders.
+
+The reference gates every layer behind numeric-vs-analytic gradient checks
+(gserver/tests/LayerGradUtil.h:299 testLayerGrad, perturbation loop
+:204-279) and random input builders (paddle/testing/TestUtil.h). Same
+contract here: build a one-layer net from a LayerConf, compare jax.grad
+against central finite differences for every parameter and every
+differentiable input.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import InputConf, LayerConf, ModelConf
+from paddle_tpu.network import Network
+
+
+def make_seq_lens(rng: np.random.Generator, batch: int, max_len: int):
+    lens = rng.integers(1, max_len + 1, size=batch)
+    lens[rng.integers(0, batch)] = max_len  # at least one full-length row
+    return jnp.asarray(lens, jnp.int32)
+
+
+def random_arg(
+    rng: np.random.Generator,
+    spec_dim,
+    batch=4,
+    is_seq=False,
+    max_len=5,
+    is_ids=False,
+    vocab=10,
+):
+    dim = tuple(spec_dim) if isinstance(spec_dim, (tuple, list)) else (spec_dim,)
+    lead = (batch, max_len) if is_seq else (batch,)
+    lens = make_seq_lens(rng, batch, max_len) if is_seq else None
+    if is_ids:
+        ids = jnp.asarray(rng.integers(0, vocab, size=lead), jnp.int32)
+        return Arg(ids=ids, seq_lens=lens)
+    v = jnp.asarray(rng.standard_normal(lead + dim), jnp.float32)
+    return Arg(value=v, seq_lens=lens)
+
+
+def build_single_layer_net(layer_conf: LayerConf, data_confs: list) -> Network:
+    """data_confs: list of LayerConf of type 'data' matching
+    layer_conf.inputs order."""
+    model = ModelConf(layers=data_confs + [layer_conf])
+    return Network(model)
+
+
+def check_layer_grad(
+    layer_conf: LayerConf,
+    data_confs: list,
+    feed: dict,
+    *,
+    seed: int = 0,
+    eps: float = 1e-3,
+    rtol: float = 5e-2,
+    atol: float = 1e-3,
+    loss_weights: bool = True,
+    check_inputs: bool = True,
+    train: bool = False,
+):
+    """Numeric-vs-analytic gradient check, the testLayerGrad contract.
+
+    Builds net = data layers + the layer under test, defines
+    loss = sum(output * random_fixed_weight) (masked for sequences, as the
+    reference weights each output element), and compares jax.grad to
+    central differences for every parameter (and optionally every dense
+    input)."""
+    net = build_single_layer_net(layer_conf, data_confs)
+    key = jax.random.key(seed)
+    params = net.init_params(key)
+    state = net.init_state()
+    out_name = layer_conf.name
+    rng = np.random.default_rng(seed + 1)
+
+    # fixed random output weighting -> scalar loss
+    def compute_loss(params, feed):
+        outs, _ = net.forward(
+            params, feed, state=state, train=train, rng=jax.random.key(123)
+        )
+        out = outs[out_name]
+        w = jnp.asarray(
+            np.random.default_rng(seed + 2).standard_normal(out.value.shape),
+            jnp.float32,
+        )
+        v = out.value * w
+        if out.is_seq:
+            m = out.mask(v.dtype)
+            v = v * m.reshape(m.shape + (1,) * (v.ndim - 2))
+        return jnp.sum(v)
+
+    # analytic
+    g_params = jax.grad(compute_loss)(params, feed)
+
+    # numeric per parameter
+    def numeric_grad(getter, setter, shape, nelem_cap=64):
+        flat_idx = np.arange(int(np.prod(shape)))
+        if len(flat_idx) > nelem_cap:
+            flat_idx = np.random.default_rng(seed + 3).choice(
+                flat_idx, nelem_cap, replace=False
+            )
+        grads = {}
+        for fi in flat_idx:
+            idx = np.unravel_index(fi, shape)
+            base = getter()
+            pert = np.asarray(base).copy()
+            pert[idx] += eps
+            lp = float(compute_loss(*setter(jnp.asarray(pert))))
+            pert[idx] -= 2 * eps
+            lm = float(compute_loss(*setter(jnp.asarray(pert))))
+            grads[idx] = (lp - lm) / (2 * eps)
+        return grads
+
+    failures = []
+    for pname, pval in params.items():
+        def getter(pname=pname):
+            return params[pname]
+
+        def setter(v, pname=pname):
+            p2 = dict(params)
+            p2[pname] = v
+            return (p2, feed)
+
+        num = numeric_grad(getter, setter, pval.shape)
+        ana = np.asarray(g_params[pname])
+        for idx, gn in num.items():
+            ga = float(ana[idx])
+            if not np.isclose(gn, ga, rtol=rtol, atol=atol):
+                failures.append(f"param {pname}{list(idx)}: numeric={gn:.6f} analytic={ga:.6f}")
+
+    if check_inputs:
+        g_feed = jax.grad(lambda f: compute_loss(params, f), allow_int=True)(feed)
+        for dname, arg in feed.items():
+            if arg.value is None:
+                continue
+
+            def getter(dname=dname):
+                return feed[dname].value
+
+            def setter(v, dname=dname):
+                f2 = dict(feed)
+                f2[dname] = feed[dname].with_value(v)
+                return (params, f2)
+
+            num = numeric_grad(getter, setter, arg.value.shape)
+            ana = np.asarray(g_feed[dname].value)
+            for idx, gn in num.items():
+                ga = float(ana[idx])
+                if not np.isclose(gn, ga, rtol=rtol, atol=atol):
+                    failures.append(
+                        f"input {dname}{list(idx)}: numeric={gn:.6f} analytic={ga:.6f}"
+                    )
+
+    assert not failures, (
+        f"gradient check failed for layer {layer_conf.type}:\n" + "\n".join(failures[:20])
+    )
+
+
+def data_conf(name, dim, is_seq=False, is_ids=False, has_subseq=False):
+    dim = tuple(dim) if isinstance(dim, (tuple, list)) else (dim,)
+    return LayerConf(
+        name=name,
+        type="data",
+        size=int(np.prod(dim)),
+        attrs={"dim": dim, "is_seq": is_seq, "is_ids": is_ids, "has_subseq": has_subseq},
+    )
+
+
+def input_conf(name, **attrs):
+    return InputConf(name=name, attrs=attrs)

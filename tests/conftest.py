@@ -1,0 +1,16 @@
+"""Test env: force an 8-device CPU mesh so distributed paths are testable
+without TPU hardware — the analogue of the reference's GPU-stub CPU-only
+test mode (paddle/cuda/include/stub/*.h); see SURVEY.md §4."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

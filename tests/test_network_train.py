@@ -1,0 +1,134 @@
+"""End-to-end network training sanity — the test_TrainerOnePass.cpp
+equivalent (reference: paddle/trainer/tests/test_TrainerOnePass.cpp:80):
+build a small net, train steps, assert the cost drops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.arg import Arg, id_arg, non_seq
+from paddle_tpu.core.config import (
+    InputConf,
+    LayerConf,
+    ModelConf,
+    OptimizationConf,
+)
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+
+def make_mlp_conf(in_dim=10, hidden=16, classes=3):
+    return ModelConf(
+        layers=[
+            LayerConf(name="x", type="data", size=in_dim,
+                      attrs={"dim": (in_dim,), "is_seq": False, "is_ids": False}),
+            LayerConf(name="y", type="data", size=1,
+                      attrs={"dim": (1,), "is_seq": False, "is_ids": True}),
+            LayerConf(name="h1", type="fc", size=hidden,
+                      inputs=[InputConf("x")], active_type="tanh"),
+            LayerConf(name="out", type="fc", size=classes,
+                      inputs=[InputConf("h1")]),
+            LayerConf(name="cost", type="classification_cost", size=1,
+                      inputs=[InputConf("out"), InputConf("y")], bias=False),
+        ],
+    )
+
+
+def synth_classif(n=256, d=10, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((d, classes))
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.standard_normal((n, classes)), axis=1)
+    return x, y.astype(np.int32)
+
+
+def test_mlp_trains():
+    conf = make_mlp_conf()
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(
+        OptimizationConf(learning_method="sgd", learning_rate=0.1, momentum=0.9),
+        net.param_confs,
+    )
+    opt_state = opt.init_state(params)
+
+    x, y = synth_classif()
+
+    @jax.jit
+    def step(params, opt_state, xb, yb, i):
+        feed = {"x": non_seq(xb), "y": id_arg(yb)}
+        (loss, _), grads = jax.value_and_grad(net.loss_fn, has_aux=True)(
+            params, feed
+        )
+        params, opt_state = opt.update(grads, params, opt_state, i)
+        return params, opt_state, loss
+
+    losses = []
+    bs = 32
+    for i in range(40):
+        s = (i * bs) % 256
+        params, opt_state, loss = step(
+            params, opt_state, x[s : s + bs], y[s : s + bs], i
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, f"cost did not drop: {losses[0]} -> {losses[-1]}"
+
+
+def test_optimizers_all_decrease():
+    from paddle_tpu.core.registry import OPTIMIZERS
+
+    x, y = synth_classif(n=128)
+    for method in ["sgd", "adagrad", "adadelta", "rmsprop", "decayed_adagrad", "adam", "adamax"]:
+        conf = make_mlp_conf()
+        net = Network(conf)
+        params = net.init_params(jax.random.key(1))
+        lr = {"sgd": 0.1, "adadelta": 1.0}.get(method, 0.05)
+        opt = create_optimizer(
+            OptimizationConf(learning_method=method, learning_rate=lr),
+            net.param_confs,
+        )
+        st = opt.init_state(params)
+
+        @jax.jit
+        def step(params, st, xb, yb, i):
+            feed = {"x": non_seq(xb), "y": id_arg(yb)}
+            (loss, _), grads = jax.value_and_grad(net.loss_fn, has_aux=True)(params, feed)
+            params, st = opt.update(grads, params, st, i)
+            return params, st, loss
+
+        first = last = None
+        for i in range(30):
+            params, st, loss = step(params, st, x, y, i)
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first, f"{method}: {first} -> {last}"
+
+
+def test_model_conf_json_roundtrip():
+    conf = make_mlp_conf()
+    s = conf.to_json()
+    conf2 = ModelConf.from_json(s)
+    net1, net2 = Network(conf), Network(conf2)
+    assert net1.order == net2.order
+    assert sorted(net1.param_confs) == sorted(net2.param_confs)
+
+
+def test_batchnorm_state_updates():
+    conf = ModelConf(
+        layers=[
+            LayerConf(name="x", type="data", size=8,
+                      attrs={"dim": (8,), "is_seq": False, "is_ids": False}),
+            LayerConf(name="bn", type="batch_norm", size=8, inputs=[InputConf("x")]),
+        ],
+    )
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    state = net.init_state()
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((16, 8)) * 3 + 1,
+                    jnp.float32)
+    outs, new_state = net.forward(params, {"x": Arg(value=x)}, state=state, train=True)
+    assert not np.allclose(np.asarray(new_state["bn"]["mean"]), 0.0)
+    # inference uses (and does not modify) running stats
+    outs2, st2 = net.forward(params, {"x": Arg(value=x)}, state=new_state, train=False)
+    assert np.allclose(np.asarray(st2["bn"]["mean"]), np.asarray(new_state["bn"]["mean"]))
+    assert np.allclose(np.asarray(st2["bn"]["var"]), np.asarray(new_state["bn"]["var"]))

@@ -1,0 +1,94 @@
+"""Regressions from the round-1 code review."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.config import InputConf, LayerConf, ModelConf, ParameterConf
+from paddle_tpu.network import Network
+from paddle_tpu.testing import check_layer_grad, data_conf, random_arg
+
+
+def test_conv_trans_shape_and_grad():
+    dcs = [data_conf("img", (4, 4, 2))]
+    lc = LayerConf(
+        name="ct", type="exconvt", size=3, inputs=[InputConf("img")],
+        attrs={"filter_size": 3, "stride": 2, "padding": 1, "num_filters": 3},
+    )
+    net = Network(ModelConf(layers=dcs + [lc]))
+    # declared spec must match actual output: (4-1)*2 + 3 - 2*1 = 7
+    assert net.specs["ct"].dim == (7, 7, 3)
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    feed = {"img": random_arg(rng, (4, 4, 2), batch=2)}
+    outs, _ = net.forward(params, feed)
+    assert outs["ct"].value.shape == (2, 7, 7, 3)
+    check_layer_grad(lc, dcs, feed)
+
+
+def test_conv_trans_inverts_conv_shape():
+    # stride-2 conv 8->4, then conv_trans stride-2 back to 8
+    dcs = [data_conf("img", (8, 8, 1))]
+    layers = dcs + [
+        LayerConf(name="c", type="exconv", size=2, inputs=[InputConf("img")],
+                  attrs={"filter_size": 4, "stride": 2, "padding": 1, "num_filters": 2}),
+        LayerConf(name="ct", type="exconvt", size=1, inputs=[InputConf("c")],
+                  attrs={"filter_size": 4, "stride": 2, "padding": 1, "num_filters": 1}),
+    ]
+    net = Network(ModelConf(layers=layers))
+    assert net.specs["c"].dim == (4, 4, 2)
+    assert net.specs["ct"].dim == (8, 8, 1)
+
+
+def test_gru_user_param_no_aliasing():
+    dcs = [data_conf("x", 9, is_seq=True)]
+    lc = LayerConf(
+        name="gru", type="grumemory", size=3,
+        inputs=[InputConf("x", parameter=ParameterConf(initial_std=0.1))],
+    )
+    net = Network(ModelConf(layers=dcs + [lc]))
+    names = sorted(net.param_confs)
+    dims = {n: tuple(net.param_confs[n].dims) for n in names}
+    assert dims["_gru.w0"] == (3, 6), dims
+    assert dims["_gru.wc"] == (3, 3), dims
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    feed = {"x": random_arg(rng, 9, batch=2, is_seq=True, max_len=3)}
+    outs, _ = net.forward(params, feed)
+    assert outs["gru"].value.shape == (2, 3, 3)
+
+
+def test_missing_feed_clear_error():
+    dcs = [data_conf("x", 4)]
+    lc = LayerConf(name="fc", type="fc", size=2, inputs=[InputConf("x")])
+    net = Network(ModelConf(layers=dcs + [lc]))
+    params = net.init_params(jax.random.key(0))
+    try:
+        net.forward(params, {"X_typo": None})
+        raise AssertionError("expected KeyError")
+    except KeyError as e:
+        assert "missing from feed" in str(e)
+
+
+def test_batchnorm_default_state_and_seq_masking():
+    # no explicit state: must not crash
+    conf = ModelConf(layers=[
+        data_conf("x", 4, is_seq=True),
+        LayerConf(name="bn", type="batch_norm", size=4, inputs=[InputConf("x")]),
+    ])
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    v = jnp.asarray(rng.standard_normal((2, 3, 4)), jnp.float32)
+    lens = jnp.asarray([3, 2], jnp.int32)
+    from paddle_tpu.core.arg import Arg
+
+    outs, _ = net.forward(params, {"x": Arg(value=v, seq_lens=lens)}, train=True)
+
+    # padding must not change real-timestep outputs: re-pad to T=6
+    v2 = jnp.concatenate([v, jnp.zeros((2, 3, 4), jnp.float32)], axis=1)
+    outs2, _ = net.forward(params, {"x": Arg(value=v2, seq_lens=lens)}, train=True)
+    a = np.asarray(outs["bn"].value)
+    b = np.asarray(outs2["bn"].value)[:, :3]
+    mask = np.arange(3)[None, :, None] < np.asarray(lens)[:, None, None]
+    np.testing.assert_allclose(a * mask, b * mask, rtol=1e-5, atol=1e-5)
