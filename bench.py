@@ -1,0 +1,79 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Headline: AlexNet ms/batch at bs=128, the reference's published number
+(benchmark/README.md:37: 334 ms/batch on 1×K40m, `paddle train --job=time`
+harness, see BASELINE.md). vs_baseline = reference_ms / our_ms (speedup
+factor; >1 means faster than the published reference).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ALEXNET_BS128_MS = 334.0
+
+
+def main():
+    import jax
+
+    from paddle_tpu.core.arg import id_arg, non_seq
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.models import alexnet
+    from paddle_tpu.network import Network
+    from paddle_tpu.optimizers import create_optimizer
+    from paddle_tpu.parallel.dp import TrainStep
+
+    bs = 128
+    conf = alexnet(image_shape=(224, 224, 3), num_classes=1000)
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(
+        OptimizationConf(
+            learning_method="momentum", learning_rate=0.001, momentum=0.9
+        ),
+        net.param_confs,
+    )
+    opt_state = opt.init_state(params)
+    state = net.init_state()
+    step = TrainStep(net, opt)
+
+    rng = np.random.default_rng(0)
+    image = rng.standard_normal((bs, 224, 224, 3)).astype(np.float32)
+    label = rng.integers(0, 1000, bs).astype(np.int32)
+    feed = {"image": non_seq(image), "label": id_arg(label)}
+    # measure compute, not host->device transfer of the synthetic batch
+    feed = jax.device_put(feed)
+
+    key = jax.random.key(1)
+    # warmup / compile (float() fetch forces execution; on the axon
+    # tunnel block_until_ready does not force the dependency chain)
+    params, opt_state, state, loss, _ = step(
+        params, opt_state, state, feed, 0, key
+    )
+    float(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(1, iters + 1):
+        params, opt_state, state, loss, _ = step(
+            params, opt_state, state, feed, i, key
+        )
+    float(loss)
+    ms = (time.perf_counter() - t0) / iters * 1e3
+
+    print(
+        json.dumps(
+            {
+                "metric": "alexnet_train_ms_per_batch_bs128",
+                "value": round(ms, 3),
+                "unit": "ms/batch",
+                "vs_baseline": round(BASELINE_ALEXNET_BS128_MS / ms, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

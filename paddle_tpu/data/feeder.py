@@ -1,0 +1,152 @@
+"""DataFeeder: python samples -> Arg batches (ragged -> dense packing).
+
+Reference: python/paddle/v2/data_feeder.py + the input-type declarations of
+PyDataProvider2.py:47-214 (dense_vector, integer_value, sparse_*, each ×
+{no_sequence, sequence, sub_sequence}). The reference emits padding-free
+flat buffers + start positions; we emit dense [B, T_bucket] + lengths
+(see core/arg.py for why). Bucketing rounds T up to a power-of-two-ish
+bucket so XLA recompiles only per bucket, not per batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.core.arg import Arg
+
+
+@dataclass(frozen=True)
+class InputType:
+    kind: str  # dense | ids | sparse_binary | sparse_float
+    dim: tuple  # feature shape
+    seq: int  # 0 = none, 1 = sequence, 2 = sub-sequence
+
+
+def dense_vector(dim, seq_type=0):
+    dim = tuple(dim) if isinstance(dim, (tuple, list)) else (dim,)
+    return InputType("dense", dim, seq_type)
+
+
+def integer_value(vocab, seq_type=0):
+    return InputType("ids", (1,), seq_type)
+
+
+def sparse_binary_vector(dim, seq_type=0):
+    return InputType("sparse_binary", (dim,), seq_type)
+
+
+def sparse_float_vector(dim, seq_type=0):
+    return InputType("sparse_float", (dim,), seq_type)
+
+
+# sequence variants, mirroring PyDataProvider2 naming
+def dense_vector_sequence(dim):
+    return dense_vector(dim, 1)
+
+
+def integer_value_sequence(vocab):
+    return integer_value(vocab, 1)
+
+
+def integer_value_sub_sequence(vocab):
+    return integer_value(vocab, 2)
+
+
+def _bucket(n: int, buckets=None) -> int:
+    """Round up to a bucket to bound recompilation."""
+    if buckets:
+        for b in buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"sequence of length {n} exceeds the largest bucket "
+            f"{buckets[-1]}; add a larger bucket or truncate upstream"
+        )
+    b = 8
+    while b < n:
+        b *= 2 if b < 128 else 1
+        if b >= 128:
+            b = ((n + 127) // 128) * 128
+            break
+    return b
+
+
+class DataFeeder:
+    """feeding maps data-layer name -> position in each sample tuple."""
+
+    def __init__(self, feeding: dict, types: dict, buckets=None):
+        self.feeding = feeding
+        self.types = types
+        self.buckets = buckets
+
+    def __call__(self, batch: list) -> dict:
+        return self.convert(batch)
+
+    def convert(self, batch: list) -> dict:
+        out = {}
+        for name, pos in self.feeding.items():
+            t = self.types[name]
+            column = [sample[pos] for sample in batch]
+            out[name] = self._column_to_arg(column, t)
+        return out
+
+    def _column_to_arg(self, column, t: InputType) -> Arg:
+        b = len(column)
+        if t.seq == 0:
+            if t.kind == "dense":
+                v = np.asarray(column, np.float32).reshape((b,) + t.dim)
+                return Arg(value=v)
+            if t.kind == "ids":
+                ids = np.asarray(column, np.int64).reshape(b).astype(np.int32)
+                return Arg(ids=ids)
+            if t.kind in ("sparse_binary", "sparse_float"):
+                v = np.zeros((b,) + t.dim, np.float32)
+                for i, row in enumerate(column):
+                    if t.kind == "sparse_binary":
+                        v[i, np.asarray(row, np.int64)] = 1.0
+                    else:
+                        idx, vals = row
+                        v[i, np.asarray(idx, np.int64)] = np.asarray(
+                            vals, np.float32
+                        )
+                return Arg(value=v)
+        if t.seq == 1:
+            lens = np.asarray([len(s) for s in column], np.int32)
+            tmax = _bucket(int(lens.max()) if b else 1, self.buckets)
+            if t.kind == "ids":
+                ids = np.zeros((b, tmax), np.int32)
+                for i, s in enumerate(column):
+                    ids[i, : len(s)] = np.asarray(s, np.int64)
+                return Arg(ids=ids, seq_lens=lens)
+            v = np.zeros((b, tmax) + t.dim, np.float32)
+            for i, s in enumerate(column):
+                v[i, : len(s)] = np.asarray(s, np.float32).reshape(
+                    (len(s),) + t.dim
+                )
+            return Arg(value=v, seq_lens=lens)
+        if t.seq == 2:
+            # sub-sequences: sample = list of list of tokens/vectors
+            sub_lens = [[len(ss) for ss in s] for s in column]
+            smax = max(len(s) for s in sub_lens)
+            flat_lens = np.asarray([sum(s) for s in sub_lens], np.int32)
+            tmax = _bucket(int(flat_lens.max()), self.buckets)
+            subl = np.zeros((b, smax), np.int32)
+            for i, s in enumerate(sub_lens):
+                subl[i, : len(s)] = s
+            if t.kind == "ids":
+                ids = np.zeros((b, tmax), np.int32)
+                for i, s in enumerate(column):
+                    flat = [tok for ss in s for tok in ss]
+                    ids[i, : len(flat)] = flat
+                return Arg(ids=ids, seq_lens=flat_lens, subseq_lens=subl)
+            v = np.zeros((b, tmax) + t.dim, np.float32)
+            for i, s in enumerate(column):
+                flat = np.asarray(
+                    [tok for ss in s for tok in ss], np.float32
+                ).reshape(-1, *t.dim)
+                v[i, : len(flat)] = flat
+            return Arg(value=v, seq_lens=flat_lens, subseq_lens=subl)
+        raise ValueError(f"unsupported input type {t}")

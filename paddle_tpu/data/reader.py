@@ -1,0 +1,177 @@
+"""Reader creators and combinators.
+
+Reference: python/paddle/v2/reader/decorator.py:26-292 (map_readers,
+buffered, compose, chain, shuffle, ComposeNotAligned, firstn) and
+python/paddle/v2/reader/creator.py. A reader is a zero-arg callable
+returning an iterator over samples; combinators wrap readers. The
+double-buffer thread of the reference's C++ DataProvider
+(gserver/dataproviders/DataProvider.h:249 DoubleBuffer) maps to
+`buffered`, which prefetches on a background thread.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def np_array(x):
+    """reader from an in-memory array: yields rows."""
+
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def map_readers(func, *readers):
+    """(decorator.py:26) new reader yielding func over outputs of readers."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader_fn, buf_size, seed=None):
+    """(decorator.py:48) buffered shuffle."""
+
+    def reader():
+        rnd = _random.Random(seed)
+        buf = []
+        for e in reader_fn():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rnd.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rnd.shuffle(buf)
+            yield from buf
+
+    return reader
+
+
+def chain(*readers):
+    """(decorator.py:83) concatenate readers."""
+
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """(decorator.py:115) zip readers into tuple samples."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*rs):
+                if any(i is None for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned"
+                    )
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*rs):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader_fn, size):
+    """(decorator.py:162) background-thread prefetch — the DoubleBuffer
+    equivalent (DataProvider.h:249)."""
+
+    class _End:
+        pass
+
+    class _Raise:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def reader():
+        q = queue.Queue(maxsize=size)
+
+        def producer():
+            try:
+                for e in reader_fn():
+                    q.put(e)
+            except BaseException as exc:  # propagate to the consumer
+                q.put(_Raise(exc))
+            else:
+                q.put(_End)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            if isinstance(e, _Raise):
+                raise e.exc
+            yield e
+
+    return reader
+
+
+def firstn(reader_fn, n):
+    """(decorator.py:233) limit to first n samples."""
+
+    def reader():
+        return itertools.islice(reader_fn(), n)
+
+    return reader
+
+
+def cache(reader_fn):
+    """Materialize once, then replay from memory."""
+    data = []
+    filled = []
+
+    def reader():
+        if not filled:
+            data.extend(reader_fn())
+            filled.append(True)
+        return iter(data)
+
+    return reader
+
+
+def batched(reader_fn, batch_size, drop_last=True):
+    """Group samples into lists (python/paddle/v2/minibatch.py)."""
+
+    def reader():
+        buf = []
+        for e in reader_fn():
+            buf.append(e)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return reader

@@ -1,0 +1,316 @@
+"""Config-building DSL — the user-facing layer functions.
+
+Reference: python/paddle/trainer_config_helpers/layers.py (6212 LoC of
+`*_layer` functions emitting LayerConfig protos) and
+python/paddle/v2/layer.py. Same programming model: each function appends a
+LayerConf to an ambient graph under construction and returns a handle
+usable as an input to later calls.
+
+    with model() as m:
+        img = data("image", dim=(28, 28, 1))
+        lbl = data("label", dim=(1,), is_ids=True)
+        h = fc(img, size=128, act="tanh")
+        out = fc(h, size=10)
+        classification_cost(out, lbl)
+    net = Network(m.conf)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from paddle_tpu.core.config import (
+    InputConf,
+    LayerConf,
+    ModelConf,
+    ParameterConf,
+    SubModelConf,
+)
+
+
+@dataclass
+class GraphBuilder:
+    conf: ModelConf = field(default_factory=ModelConf)
+    _counts: dict = field(default_factory=dict)
+
+    def uniq(self, prefix: str) -> str:
+        n = self._counts.get(prefix, 0)
+        self._counts[prefix] = n + 1
+        return f"__{prefix}_{n}__"
+
+    def add(self, lc: LayerConf) -> "LayerRef":
+        self.conf.layers.append(lc)
+        return LayerRef(lc.name, self)
+
+
+@dataclass(frozen=True)
+class LayerRef:
+    name: str
+    builder: GraphBuilder
+
+    def __add__(self, other: "LayerRef") -> "LayerRef":
+        return addto(self, other)
+
+
+_stack: list = []
+
+
+def current() -> GraphBuilder:
+    if not _stack:
+        raise RuntimeError("no model() context active")
+    return _stack[-1]
+
+
+@contextlib.contextmanager
+def model():
+    g = GraphBuilder()
+    _stack.append(g)
+    try:
+        yield g
+    finally:
+        _stack.pop()
+
+
+def _in(x) -> InputConf:
+    if isinstance(x, InputConf):
+        return x
+    return InputConf(name=x.name if isinstance(x, LayerRef) else x)
+
+
+def _add(type_, inputs, name=None, size=0, act="", bias=True, param=None,
+         bias_param=None, drop_rate=0.0, **attrs):
+    g = current()
+    name = name or g.uniq(type_)
+    ins = []
+    for i, x in enumerate(inputs):
+        ic = _in(x)
+        if param is not None and i == 0 and ic.parameter is None:
+            ic.parameter = param
+        ins.append(ic)
+    lc = LayerConf(
+        name=name, type=type_, size=size, inputs=ins, active_type=act,
+        bias=bias, bias_parameter=bias_param, drop_rate=drop_rate, attrs=attrs,
+    )
+    return g.add(lc)
+
+
+# ---- inputs ----
+
+def data(name, dim, is_seq=False, is_ids=False, has_subseq=False):
+    dim = tuple(dim) if isinstance(dim, (tuple, list)) else (dim,)
+    g = current()
+    lc = LayerConf(
+        name=name, type="data", size=int(np.prod(dim)),
+        attrs={"dim": dim, "is_seq": is_seq, "is_ids": is_ids,
+               "has_subseq": has_subseq},
+    )
+    g.conf.input_layer_names.append(name)
+    return g.add(lc)
+
+
+# ---- dense / basic ----
+
+def fc(*inputs, size, name=None, act="", bias=True, param=None, drop_rate=0.0):
+    return _add("fc", inputs, name=name, size=size, act=act, bias=bias,
+                param=param, drop_rate=drop_rate)
+
+
+def embedding(ids, size, vocab_size, name=None, param=None, sharded=False):
+    """sharded=True marks the table for row-sharding across the mesh — the
+    pserver-sharded large-embedding analogue (SURVEY.md 'MP sparse')."""
+    return _add("embedding", [ids], name=name, size=size, bias=False,
+                param=param, vocab_size=vocab_size, sharded=sharded)
+
+
+def addto(*inputs, name=None, act="", bias=False):
+    return _add("addto", inputs, name=name, act=act, bias=bias)
+
+
+def concat(*inputs, name=None):
+    return _add("concat", inputs, name=name)
+
+
+def cos_sim(a, b, scale=1.0, name=None):
+    return _add("cos", [a, b], name=name, scale=scale)
+
+
+def dropout(x, rate, name=None):
+    return _add("addto", [x], name=name, bias=False, drop_rate=rate)
+
+
+def mixed(size, inputs, name=None, act="", bias=True):
+    """inputs: list of (layer, proj, extra_attrs) or InputConf."""
+    ins = []
+    for item in inputs:
+        if isinstance(item, tuple):
+            layer, proj, *rest = item
+            attrs = {"proj": proj}
+            if rest:
+                attrs.update(rest[0])
+            ins.append(InputConf(name=layer.name, attrs=attrs))
+        else:
+            ins.append(_in(item))
+    return _add("mixed", ins, name=name, size=size, act=act, bias=bias)
+
+
+# ---- image ----
+
+def conv(x, num_filters, filter_size, stride=1, padding=0, groups=1,
+         dilation=1, name=None, act="relu", bias=True, param=None):
+    return _add("exconv", [x], name=name, size=num_filters, act=act, bias=bias,
+                param=param, num_filters=num_filters, filter_size=filter_size,
+                stride=stride, padding=padding, groups=groups, dilation=dilation)
+
+
+def conv_trans(x, num_filters, filter_size, stride=1, padding=0, name=None,
+               act="relu", bias=True):
+    return _add("exconvt", [x], name=name, size=num_filters, act=act,
+                bias=bias, num_filters=num_filters, filter_size=filter_size,
+                stride=stride, padding=padding)
+
+
+def pool(x, pool_size, stride=None, padding=0, pool_type="max", name=None):
+    return _add("pool", [x], name=name, pool_type=pool_type,
+                pool_size=pool_size, stride=stride or pool_size,
+                padding=padding)
+
+
+def batch_norm(x, name=None, act="", use_global_stats=False,
+               moving_average_fraction=0.9, epsilon=1e-5):
+    return _add("batch_norm", [x], name=name, act=act,
+                use_global_stats=use_global_stats,
+                moving_average_fraction=moving_average_fraction,
+                epsilon=epsilon)
+
+
+def lrn(x, size=5, scale=1e-4, power=0.75, name=None):
+    return _add("norm", [x], name=name, size=size, scale=scale, pow=power)
+
+
+def maxout(x, groups, name=None):
+    return _add("maxout", [x], name=name, groups=groups)
+
+
+def spp(x, pyramid_height=3, pool_type="max", name=None):
+    return _add("spp", [x], name=name, pyramid_height=pyramid_height,
+                pool_type=pool_type)
+
+
+def block_expand(x, block, stride=None, padding=0, name=None):
+    return _add("blockexpand", [x], name=name, block=block,
+                stride=stride or block, padding=padding)
+
+
+# ---- recurrence ----
+
+def recurrent(x, size, name=None, act="tanh", reversed=False, bias=True):
+    return _add("recurrent", [x], name=name, size=size, act=act,
+                bias=bias, reversed=reversed)
+
+
+def lstmemory(x, size, name=None, act="tanh", gate_act="sigmoid",
+              state_act="tanh", reversed=False, bias=True, param=None):
+    return _add("lstmemory", [x], name=name, size=size, act=act, bias=bias,
+                param=param, active_gate_type=gate_act,
+                active_state_type=state_act, reversed=reversed)
+
+
+def grumemory(x, size, name=None, act="tanh", gate_act="sigmoid",
+              reversed=False, bias=True, param=None):
+    return _add("grumemory", [x], name=name, size=size, act=act, bias=bias,
+                param=param, active_gate_type=gate_act, reversed=reversed)
+
+
+def simple_lstm(x, size, name=None, act="tanh", reversed=False):
+    """fc(4h) + lstmemory — the networks.py simple_lstm
+    (trainer_config_helpers/networks.py:548)."""
+    proj = fc(x, size=size * 4, name=(name or "lstm") + "_proj", bias=True)
+    return lstmemory(proj, size=size, name=name, act=act, reversed=reversed)
+
+
+def simple_gru(x, size, name=None, act="tanh", reversed=False):
+    """(networks.py:975 simple_gru)."""
+    proj = fc(x, size=size * 3, name=(name or "gru") + "_proj", bias=True)
+    return grumemory(proj, size=size, name=name, act=act, reversed=reversed)
+
+
+def bidirectional_lstm(x, size, name=None, return_concat=True):
+    """(networks.py:1207 bidirectional_lstm)."""
+    fwd = simple_lstm(x, size, name=(name or "bilstm") + "_fwd")
+    bwd = simple_lstm(x, size, name=(name or "bilstm") + "_bwd", reversed=True)
+    return concat(fwd, bwd) if return_concat else (fwd, bwd)
+
+
+# ---- sequence structure ----
+
+def seq_pool(x, pool_type="sum", level="seq", name=None):
+    return _add("seqpool", [x], name=name, pool_type=pool_type, level=level)
+
+
+def last_seq(x, name=None):
+    return _add("seqlastins", [x], name=name)
+
+
+def first_seq(x, name=None):
+    return _add("seqlastins", [x], name=name, select_first=True)
+
+
+def expand(x, ref, name=None):
+    return _add("expand", [x, ref], name=name)
+
+
+def seq_concat(a, b, name=None):
+    return _add("seqconcat", [a, b], name=name)
+
+
+def seq_reverse(x, name=None):
+    return _add("seqreverse", [x], name=name)
+
+
+# ---- costs ----
+
+def classification_cost(logits, label, name=None, coeff=1.0):
+    return _add("classification_cost", [logits, label], name=name or "cost",
+                bias=False, coeff=coeff)
+
+
+def cross_entropy(prob, label, name=None, coeff=1.0):
+    return _add("multi-class-cross-entropy", [prob, label],
+                name=name or "cost", bias=False, coeff=coeff)
+
+
+def square_error(x, y, name=None, coeff=1.0):
+    return _add("square_error", [x, y], name=name or "cost", bias=False,
+                coeff=coeff)
+
+
+def rank_cost(a, b, label, name=None, coeff=1.0):
+    return _add("rank-cost", [a, b, label], name=name or "cost", bias=False,
+                coeff=coeff)
+
+
+# ---- prebuilt networks (trainer_config_helpers/networks.py) ----
+
+def simple_img_conv_pool(x, num_filters, filter_size, pool_size, pool_stride,
+                         act="relu", name=None, padding=0):
+    """(networks.py:145 simple_img_conv_pool)."""
+    c = conv(x, num_filters, filter_size, padding=padding, act=act,
+             name=(name or "convpool") + "_conv")
+    return pool(c, pool_size, pool_stride, name=(name or "convpool") + "_pool")
+
+
+def img_conv_group(x, conv_num_filter, conv_filter_size,
+                   pool_size, pool_stride, conv_act="relu",
+                   conv_with_batchnorm=False, pool_type="max"):
+    """A VGG block (networks.py:333 img_conv_group)."""
+    h = x
+    for i, nf in enumerate(conv_num_filter):
+        h = conv(h, nf, conv_filter_size, padding=(conv_filter_size - 1) // 2,
+                 act="" if conv_with_batchnorm else conv_act)
+        if conv_with_batchnorm:
+            h = batch_norm(h, act=conv_act)
+    return pool(h, pool_size, pool_stride, pool_type=pool_type)

@@ -1,0 +1,237 @@
+"""Evaluators — streaming task metrics.
+
+Reference: paddle/gserver/evaluators/Evaluator.cpp:40-1346
+(classification_error, sum, column_sum, precision_recall, pnpair, rankauc,
+printers) with start/evalImp/finish accumulation across batches. Same
+contract: `start()`, `add_batch(outs, feed)` per batch (device work is one
+jnp reduction; accumulation is host floats), `result()`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import EVALUATORS
+
+
+class Evaluator:
+    """conf: {"name", "type", "input", "label", ...} — evaluator configs
+    reference output/label layers by name."""
+
+    def __init__(self, conf: dict):
+        self.conf = conf
+        self.name = conf.get("name", conf["type"])
+        self.start()
+
+    def start(self):
+        raise NotImplementedError
+
+    def add_batch(self, outs: dict, feed: dict):
+        raise NotImplementedError
+
+    def result(self):
+        raise NotImplementedError
+
+    # helpers
+    def _get(self, outs, feed, key):
+        name = self.conf[key]
+        if name in outs:
+            return outs[name]
+        return feed[name]
+
+    @staticmethod
+    def _masked_pairs(pred: Arg, label: Arg):
+        """Return flat (pred_rows, label_ids, weight) with padding dropped
+        via mask weights (sequence-aware, like the reference's
+        sequence-level eval accounting)."""
+        p = np.asarray(pred.value)
+        l = np.asarray(label.ids if label.ids is not None else label.value)
+        if pred.is_seq:
+            m = np.asarray(pred.mask())
+            p = p.reshape(-1, p.shape[-1])
+            l = l.reshape(-1)
+            w = m.reshape(-1)
+        else:
+            p = p.reshape(p.shape[0], -1)
+            l = l.reshape(-1)
+            w = np.ones(p.shape[0])
+        return p, l, w
+
+
+@EVALUATORS.register("classification_error")
+class ClassificationErrorEvaluator(Evaluator):
+    """(Evaluator.cpp:172 ClassificationErrorEvaluator)."""
+
+    def start(self):
+        self.wrong = 0.0
+        self.total = 0.0
+
+    def add_batch(self, outs, feed):
+        pred = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        p, l, w = self._masked_pairs(pred, label)
+        hit = (np.argmax(p, axis=-1) == l).astype(np.float64)
+        self.wrong += float(((1.0 - hit) * w).sum())
+        self.total += float(w.sum())
+
+    def result(self):
+        return self.wrong / max(self.total, 1.0)
+
+
+@EVALUATORS.register("sum")
+class SumEvaluator(Evaluator):
+    """(Evaluator.cpp:40 SumEvaluator)."""
+
+    def start(self):
+        self.sum = 0.0
+        self.total = 0.0
+
+    def add_batch(self, outs, feed):
+        x = self._get(outs, feed, "input")
+        v = np.asarray(x.value)
+        if x.is_seq:
+            m = np.asarray(x.mask()).reshape(v.shape[:2] + (1,) * (v.ndim - 2))
+            v = v * m
+            self.total += float(np.asarray(x.seq_lens).sum())
+        else:
+            self.total += v.shape[0]
+        self.sum += float(v.sum())
+
+    def result(self):
+        return self.sum / max(self.total, 1.0)
+
+
+@EVALUATORS.register("column_sum")
+class ColumnSumEvaluator(Evaluator):
+    """(Evaluator.cpp:503 ColumnSumEvaluator)."""
+
+    def start(self):
+        self.sum = None
+        self.total = 0.0
+
+    def add_batch(self, outs, feed):
+        x = self._get(outs, feed, "input")
+        v = np.asarray(x.value).reshape(-1, np.asarray(x.value).shape[-1])
+        s = v.sum(axis=0)
+        self.sum = s if self.sum is None else self.sum + s
+        self.total += v.shape[0]
+
+    def result(self):
+        return self.sum / max(self.total, 1.0)
+
+
+@EVALUATORS.register("precision_recall")
+class PrecisionRecallEvaluator(Evaluator):
+    """(Evaluator.cpp:862 PrecisionRecallEvaluator). Multi-class
+    macro-averaged; conf may set "positive_label" for binary."""
+
+    def start(self):
+        self.tp = {}
+        self.fp = {}
+        self.fn = {}
+
+    def add_batch(self, outs, feed):
+        pred = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        p, l, w = self._masked_pairs(pred, label)
+        yhat = np.argmax(p, axis=-1)
+        for c in np.unique(np.concatenate([yhat, l])):
+            c = int(c)
+            real = w > 0
+            self.tp[c] = self.tp.get(c, 0) + int(((yhat == c) & (l == c) & real).sum())
+            self.fp[c] = self.fp.get(c, 0) + int(((yhat == c) & (l != c) & real).sum())
+            self.fn[c] = self.fn.get(c, 0) + int(((yhat != c) & (l == c) & real).sum())
+
+    def result(self):
+        pos = self.conf.get("positive_label")
+        classes = [pos] if pos is not None else sorted(self.tp)
+        precs, recs = [], []
+        for c in classes:
+            tp, fp, fn = self.tp.get(c, 0), self.fp.get(c, 0), self.fn.get(c, 0)
+            precs.append(tp / max(tp + fp, 1))
+            recs.append(tp / max(tp + fn, 1))
+        p, r = float(np.mean(precs)), float(np.mean(recs))
+        f1 = 2 * p * r / max(p + r, 1e-12)
+        return {"precision": p, "recall": r, "F1": f1}
+
+
+@EVALUATORS.register("pnpair")
+class PnpairEvaluator(Evaluator):
+    """Positive-negative pair ordering ratio (Evaluator.cpp:995
+    PnpairEvaluator): for query-grouped (score, label) pairs, counts
+    correctly-ordered pos>neg pairs. conf: input (score), label, query_id."""
+
+    def start(self):
+        self.pairs = []  # (qid, score, label)
+
+    def add_batch(self, outs, feed):
+        score = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        qid = self._get(outs, feed, "query_id")
+        s = np.asarray(score.value).reshape(-1)
+        l = np.asarray(label.ids).reshape(-1)
+        q = np.asarray(qid.ids).reshape(-1)
+        self.pairs.extend(zip(q.tolist(), s.tolist(), l.tolist()))
+
+    def result(self):
+        from collections import defaultdict
+
+        by_q = defaultdict(list)
+        for q, s, l in self.pairs:
+            by_q[q].append((s, l))
+        good = bad = 0.0
+        for items in by_q.values():
+            for i in range(len(items)):
+                for j in range(i + 1, len(items)):
+                    (si, li), (sj, lj) = items[i], items[j]
+                    if li == lj:
+                        continue
+                    hi, lo = (si, sj) if li > lj else (sj, si)
+                    if hi > lo:
+                        good += 1
+                    elif hi < lo:
+                        bad += 1
+                    else:
+                        good += 0.5
+                        bad += 0.5
+        return good / max(bad, 1e-12)
+
+
+@EVALUATORS.register("rankauc")
+class AucEvaluator(Evaluator):
+    """ROC AUC on binary scores (Evaluator.cpp:584 AucEvaluator),
+    histogram-bucketed like the reference."""
+
+    BINS = 4096
+
+    def start(self):
+        self.pos = np.zeros(self.BINS)
+        self.neg = np.zeros(self.BINS)
+
+    def add_batch(self, outs, feed):
+        score = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        s = np.asarray(score.value)
+        s = s[..., -1] if s.shape[-1] > 1 else s.reshape(-1)
+        s = np.clip(s.reshape(-1), 0.0, 1.0)
+        l = np.asarray(label.ids).reshape(-1)
+        idx = np.minimum((s * self.BINS).astype(np.int64), self.BINS - 1)
+        np.add.at(self.pos, idx[l == 1], 1)
+        np.add.at(self.neg, idx[l == 0], 1)
+
+    def result(self):
+        # sum over thresholds of trapezoid areas, descending score
+        pos_c = np.cumsum(self.pos[::-1])
+        neg_c = np.cumsum(self.neg[::-1])
+        tot_pos, tot_neg = pos_c[-1], neg_c[-1]
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.5
+        tpr = pos_c / tot_pos
+        fpr = neg_c / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+
+def create_evaluator(conf: dict) -> Evaluator:
+    return EVALUATORS.get(conf["type"])(conf)

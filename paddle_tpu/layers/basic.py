@@ -86,6 +86,8 @@ class EmbeddingLayer(Layer):
         vocab = self.conf.attrs["vocab_size"]
         pc = self.weight_conf(0, (vocab, self.conf.size))
         pc.sparse_update = True
+        if self.conf.attrs.get("sharded", False):
+            pc.sparse_remote_update = True  # row-shard over the mesh
         return Spec(dim=(self.conf.size,), is_seq=s.is_seq), {"w0": pc}
 
     def forward(self, params, inputs, ctx):
@@ -123,23 +125,36 @@ class AddtoLayer(Layer):
 
 @LAYERS.register("concat")
 class ConcatLayer(Layer):
-    """Feature-axis concat (gserver/layers/ConcatenateLayer.cpp)."""
+    """Feature-axis concat (gserver/layers/ConcatenateLayer.cpp). When all
+    inputs are same-H,W image specs, concatenates channels and keeps the
+    spatial shape (inception-style branch merge); otherwise flattens."""
 
     def build(self, in_specs):
         seq = any(s.is_seq for s in in_specs)
+        self._image = (
+            all(len(s.dim) == 3 for s in in_specs)
+            and len({s.dim[:2] for s in in_specs}) == 1
+        )
+        if self._image:
+            h, w = in_specs[0].dim[:2]
+            c = sum(s.dim[2] for s in in_specs)
+            self._in_dims = [s.dim for s in in_specs]
+            return Spec(dim=(h, w, c), is_seq=seq), {}
         tot = sum(s.size for s in in_specs)
         return Spec(dim=(tot,), is_seq=seq), {}
 
     def forward(self, params, inputs, ctx):
         flat = []
         seq_lens = None
-        for a in inputs:
+        for i, a in enumerate(inputs):
             x = a.value
+            lead = 2 if a.is_seq else 1
             if a.is_seq:
                 seq_lens = a.seq_lens
-                x = x.reshape(x.shape[:2] + (-1,))
+            if self._image:
+                x = x.reshape(x.shape[:lead] + self._in_dims[i])
             else:
-                x = x.reshape(x.shape[:1] + (-1,))
+                x = x.reshape(x.shape[:lead] + (-1,))
             flat.append(x)
         y = jnp.concatenate(flat, axis=-1)
         y = self.apply_activation_and_dropout(y, ctx, seq_lens)

@@ -1,0 +1,12 @@
+from paddle_tpu.models.image import (  # noqa: F401
+    alexnet,
+    googlenet,
+    lenet,
+    resnet,
+    smallnet_mnist_cifar,
+    vgg16,
+)
+from paddle_tpu.models.text import (  # noqa: F401
+    bidi_lstm_tagger,
+    stacked_lstm_classifier,
+)

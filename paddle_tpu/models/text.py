@@ -1,0 +1,53 @@
+"""Text/sequence model zoo.
+
+Reference configs: benchmark/paddle/rnn/rnn.py (IMDB stacked LSTM
+classifier), v1_api_demo/quick_start (text classification),
+v1_api_demo/sequence_tagging (bidi-RNN tagger).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import dsl
+from paddle_tpu.core.config import ModelConf
+
+
+def stacked_lstm_classifier(
+    vocab_size=30000,
+    emb_dim=128,
+    hidden=256,
+    num_layers=2,
+    num_classes=2,
+    max_len=None,
+) -> ModelConf:
+    """IMDB LSTM benchmark config (benchmark/paddle/rnn/rnn.py:9-21:
+    embedding -> N×(fc+lstmemory) -> max-pool over time -> fc softmax)."""
+    with dsl.model() as g:
+        ids = dsl.data("words", (1,), is_seq=True, is_ids=True)
+        lbl = dsl.data("label", (1,), is_ids=True)
+        h = dsl.embedding(ids, size=emb_dim, vocab_size=vocab_size)
+        for i in range(num_layers):
+            h = dsl.simple_lstm(h, hidden, name=f"lstm{i}")
+        pooled = dsl.seq_pool(h, pool_type="max")
+        out = dsl.fc(pooled, size=num_classes, name="output")
+        dsl.classification_cost(out, lbl)
+        g.conf.output_layer_names.append("output")
+    return g.conf
+
+
+def bidi_lstm_tagger(
+    vocab_size=30000,
+    emb_dim=64,
+    hidden=128,
+    num_tags=9,
+) -> ModelConf:
+    """Sequence tagging with a bidirectional LSTM and per-token softmax
+    (v1_api_demo/sequence_tagging/rnn_crf.py without the CRF head for now)."""
+    with dsl.model() as g:
+        ids = dsl.data("words", (1,), is_seq=True, is_ids=True)
+        tags = dsl.data("tags", (1,), is_seq=True, is_ids=True)
+        emb = dsl.embedding(ids, size=emb_dim, vocab_size=vocab_size)
+        h = dsl.bidirectional_lstm(emb, hidden)
+        out = dsl.fc(h, size=num_tags, name="output")
+        dsl.classification_cost(out, tags)
+        g.conf.output_layer_names.append("output")
+    return g.conf

@@ -96,6 +96,7 @@ class Network:
         state: Optional[dict] = None,
         train: bool = False,
         rng: Optional[jax.Array] = None,
+        outputs: Optional[list] = None,
     ):
         """Run all layers. Returns (outputs: {layer_name: Arg}, new_state).
 
@@ -106,10 +107,26 @@ class Network:
             state = self.init_state()
         ctx = Ctx(train=train, rng=rng, state=state)
         outs: dict[str, Arg] = {}
+        if outputs is not None:
+            # run only the ancestor closure of the requested outputs
+            # (inference prunes cost layers and their label inputs)
+            run = set()
+            frontier = list(outputs)
+            while frontier:
+                n = frontier.pop()
+                if n in run:
+                    continue
+                run.add(n)
+                frontier.extend(self.conf.layer(n).input_names())
+            order = [n for n in self.order if n in run]
+        else:
+            order = self.order
         needed = {
-            n for lc in self.conf.layers for n in lc.input_names()
+            n
+            for ln in order
+            for n in self.conf.layer(ln).input_names()
         }
-        for name in self.order:
+        for name in order:
             lc = self.conf.layer(name)
             if lc.type == "data":
                 if name in feed:

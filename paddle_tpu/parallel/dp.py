@@ -1,0 +1,141 @@
+"""Data-parallel (and sharded-state) training over a device mesh.
+
+Replaces three reference subsystems with one compiled program:
+- MultiGradientMachine's per-device TrainerThreads + ring gradient merge
+  (gserver/gradientmachines/MultiGradientMachine.cpp:389,502-598),
+- the C++ sync parameter server (pserver/ParameterServer2.h:254,482,660:
+  barriers, gradient add, server-side op_SGD),
+- the Go pserver's dense shards (go/pserver/service.go:221,240).
+
+TPU-first: the batch is sharded over the mesh "data" axis; params are
+either replicated or sharded (ZeRO-style, the optimizer-state analogue of
+pserver block shards). XLA inserts the psum/all-gather over ICI. The
+optimizer runs sharded on-device — there is no parameter-server process.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import DATA_AXIS
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Arg leaves are [B, ...]: shard batch dim over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_sharding(mesh: Mesh, pc=None) -> NamedSharding:
+    """Parameter placement. Default: replicated. Large 2-D params can be
+    sharded over `data` on their output dim (ZeRO-ish) via
+    pc.attrs in future rounds; embeddings with sparse_remote_update are
+    sharded over rows (the pserver-sharded-table analogue)."""
+    if pc is not None and getattr(pc, "sparse_remote_update", False):
+        return NamedSharding(mesh, P(DATA_AXIS))
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(feed: dict, mesh: Mesh) -> dict:
+    """Device-put a host feed with batch-dim sharding."""
+    sh = batch_sharding(mesh)
+
+    def put(x):
+        return jax.device_put(x, sh) if x is not None else None
+
+    return jax.tree_util.tree_map(put, feed)
+
+
+class TrainStep:
+    """One jit-compiled train step: forward + grad + optimizer update.
+
+    With a mesh, the feed is sharded over DATA_AXIS and params/opt-state
+    are placed per `param_sharding`; XLA emits the gradient allreduce over
+    ICI (the compiled replacement for ADD_GRADIENT + barriers,
+    ParameterService.proto:24-41)."""
+
+    def __init__(
+        self,
+        net,
+        opt,
+        mesh: Optional[Mesh] = None,
+        donate=True,
+        keep_outputs=None,
+    ):
+        self.net = net
+        self.opt = opt
+        self.mesh = mesh
+        # Only declared outputs survive the step: returning every layer's
+        # activations would pin all intermediates in HBM and block XLA
+        # fusion/rematerialization.
+        keep = set(keep_outputs or []) | set(net.output_names) | set(
+            net.cost_names
+        )
+
+        def step(params, opt_state, state, feed, step_i, rng):
+            (loss, (outs, new_state)), grads = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed, state=state, train=True, rng=rng)
+            new_params, new_opt_state = opt.update(
+                grads, params, opt_state, step_i
+            )
+            outs = {k: v for k, v in outs.items() if k in keep}
+            return new_params, new_opt_state, new_state, loss, outs
+
+        if mesh is not None:
+            rep = replicated(mesh)
+            data = batch_sharding(mesh)
+            param_sh = {
+                name: param_sharding(mesh, pc)
+                for name, pc in net.param_confs.items()
+            }
+
+            def param_tree_sharding(params):
+                return {k: param_sh.get(k, rep) for k in params}
+
+            self._param_sh = param_sh
+            self._rep = rep
+            self._data = data
+            # in_shardings: params, opt_state (match params), state (rep),
+            # feed (data), step (rep), rng (rep)
+            self._step = jax.jit(
+                step,
+                donate_argnums=(0, 1, 2) if donate else (),
+            )
+        else:
+            self._step = jax.jit(
+                step, donate_argnums=(0, 1, 2) if donate else ()
+            )
+
+    def place(self, params, opt_state, state):
+        """Place params/opt-state/state on the mesh per their shardings."""
+        if self.mesh is None:
+            return params, opt_state, state
+        p = {
+            k: jax.device_put(v, self._param_sh.get(k, self._rep))
+            for k, v in params.items()
+        }
+        o = {
+            k: jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, self._param_sh.get(k, self._rep)),
+                v,
+            )
+            for k, v in opt_state.items()
+        }
+        s = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, self._rep), state
+        )
+        return p, o, s
+
+    def __call__(self, params, opt_state, state, feed, step_i, rng):
+        if self.mesh is not None:
+            feed = shard_batch(feed, self.mesh)
+        return self._step(params, opt_state, state, feed, step_i, rng)

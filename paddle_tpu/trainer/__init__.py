@@ -1,0 +1,8 @@
+from paddle_tpu.trainer.events import (  # noqa: F401
+    BeginIteration,
+    BeginPass,
+    EndIteration,
+    EndPass,
+    TestResult,
+)
+from paddle_tpu.trainer.trainer import SGD  # noqa: F401

@@ -1,0 +1,121 @@
+"""Checkpoint save/load.
+
+Reference: per-pass parameter dirs with rotation and resume
+(trainer/ParamUtil.h:77-108 saveParameters/loadParameters, save_only_one,
+start_pass), v2 tar format (python/paddle/v2/parameters.py:304,323
+to_tar/from_tar), and model merge for deployment (trainer/MergeModel.cpp).
+
+Format: a directory per pass (`pass-%05d/`) holding `params.npz`,
+`opt_state.npz` (flattened pytree), `state.npz` and `meta.json`. A merged
+single-file deployable (config JSON + weights) is `model.npz` via
+`merge_model`, the MergeModel.cpp analogue. Multi-host: only process 0
+writes (the save-model election of go/master/service.go:467-495 collapses
+to a process-id check under jax.distributed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _unflatten(flat: dict) -> dict:
+    tree: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = tree
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return tree
+
+
+def _save_npz(path, tree):
+    np.savez(path, **_flatten(tree))
+
+
+def _load_npz(path):
+    with np.load(path) as z:
+        return _unflatten({k: z[k] for k in z.files})
+
+
+def save_pass(
+    save_dir: str,
+    pass_id: int,
+    params: dict,
+    opt_state=None,
+    state=None,
+    meta=None,
+    save_only_one=False,
+):
+    if jax.process_index() != 0:
+        return None
+    d = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    os.makedirs(d, exist_ok=True)
+    _save_npz(os.path.join(d, "params.npz"), params)
+    if opt_state is not None:
+        _save_npz(os.path.join(d, "opt_state.npz"), opt_state)
+    if state:
+        _save_npz(os.path.join(d, "state.npz"), state)
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump({"pass_id": pass_id, **(meta or {})}, f)
+    if save_only_one:
+        for name in os.listdir(save_dir):
+            if name.startswith("pass-") and name != f"pass-{pass_id:05d}":
+                shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
+    return d
+
+
+def load_pass(save_dir: str, pass_id: int = -1):
+    """pass_id=-1 loads the latest. Returns (params, opt_state, state, meta)."""
+    if pass_id < 0:
+        passes = sorted(
+            int(n.split("-")[1])
+            for n in os.listdir(save_dir)
+            if n.startswith("pass-")
+        )
+        if not passes:
+            raise FileNotFoundError(f"no pass-* checkpoints in {save_dir}")
+        pass_id = passes[-1]
+    d = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    params = _load_npz(os.path.join(d, "params.npz"))
+    opt_state = state = None
+    if os.path.exists(os.path.join(d, "opt_state.npz")):
+        opt_state = _load_npz(os.path.join(d, "opt_state.npz"))
+    if os.path.exists(os.path.join(d, "state.npz")):
+        state = _load_npz(os.path.join(d, "state.npz"))
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    return params, opt_state, state, meta
+
+
+def merge_model(path: str, model_conf, params: dict, state=None):
+    """Single-file deployable: config JSON + weights (MergeModel.cpp /
+    capi merged model analogue)."""
+    flat = _flatten({"params": params, "state": state or {}})
+    np.savez(path, __config__=np.frombuffer(
+        model_conf.to_json().encode(), dtype=np.uint8
+    ), **flat)
+
+
+def load_merged(path: str):
+    from paddle_tpu.core.config import ModelConf
+
+    with np.load(path) as z:
+        conf = ModelConf.from_json(bytes(z["__config__"]).decode())
+        tree = _unflatten({k: z[k] for k in z.files if k != "__config__"})
+    return conf, tree.get("params", {}), tree.get("state", {})

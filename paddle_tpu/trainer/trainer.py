@@ -1,0 +1,247 @@
+"""SGD trainer — the event-driven training loop.
+
+Reference: python/paddle/v2/trainer.py:24,110,145-176 (SGD.train with
+event_handler), driving the same semantics as the C++ Trainer pass/batch
+loop (trainer/Trainer.cpp:261,492; TrainerInternal::trainOneBatch
+TrainerInternal.cpp:66). One jit-compiled TrainStep replaces
+forwardBackward + updater; the whole mesh runs it SPMD.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core.config import ModelConf, OptimizationConf
+from paddle_tpu.core.stat import GLOBAL_STATS
+from paddle_tpu.evaluators import create_evaluator
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+from paddle_tpu.parallel.dp import TrainStep
+from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.trainer.events import (
+    BeginIteration,
+    BeginPass,
+    EndIteration,
+    EndPass,
+    TestResult,
+)
+
+log = logging.getLogger("paddle_tpu.trainer")
+
+
+class SGD:
+    """Usage (mirrors paddle.v2.trainer.SGD):
+
+        trainer = SGD(model_conf, opt_conf, mesh=mesh, evaluators=[...])
+        trainer.train(reader=batched_reader, feeder=feeder,
+                      num_passes=10, event_handler=handler)
+    """
+
+    def __init__(
+        self,
+        model_conf: ModelConf,
+        opt_conf: OptimizationConf,
+        mesh=None,
+        evaluators: Optional[list] = None,
+        seed: int = 0,
+        params: Optional[dict] = None,
+    ):
+        self.net = Network(model_conf)
+        self.opt_conf = opt_conf
+        self.opt = create_optimizer(opt_conf, self.net.param_confs)
+        self.mesh = mesh
+        self.evaluator_confs = evaluators or []
+        key = _rng.root_key(seed or _flags.get_flag("seed"))
+        init_key, self.step_key = jax.random.split(key)
+        self.params = params if params is not None else self.net.init_params(init_key)
+        self.state = self.net.init_state()
+        self.opt_state = self.opt.init_state(self.params)
+        eval_layers = {
+            c[k]
+            for c in self.evaluator_confs
+            for k in ("input", "label", "query_id")
+            if k in c
+        }
+        self.step_fn = TrainStep(
+            self.net, self.opt, mesh=mesh, keep_outputs=eval_layers
+        )
+        self.params, self.opt_state, self.state = self.step_fn.place(
+            self.params, self.opt_state, self.state
+        )
+        self.global_step = 0
+
+    # ---- eval-only forward (jitted separately, no grad) ----
+    def _eval_forward(self, feed):
+        if not hasattr(self, "_fwd"):
+            keep = (
+                set(self.net.output_names)
+                | set(self.net.cost_names)
+                | {
+                    c[k]
+                    for c in self.evaluator_confs
+                    for k in ("input", "label", "query_id")
+                    if k in c
+                }
+            )
+
+            def fwd(params, state, feed):
+                outs, _ = self.net.forward(
+                    params, feed, state=state, train=False
+                )
+                costs = [outs[n].value for n in self.net.cost_names]
+                return {k: v for k, v in outs.items() if k in keep}, costs
+
+            self._fwd = jax.jit(fwd)
+        return self._fwd(self.params, self.state, feed)
+
+    def _make_evaluators(self):
+        return [create_evaluator(c) for c in self.evaluator_confs]
+
+    def train(
+        self,
+        reader: Callable,
+        feeder: Callable,
+        num_passes: int = 1,
+        event_handler: Optional[Callable] = None,
+        test_reader: Optional[Callable] = None,
+        save_dir: Optional[str] = None,
+        start_pass: int = 0,
+    ):
+        """reader yields raw batches (lists of sample tuples); feeder
+        converts them to Arg dicts."""
+        event_handler = event_handler or (lambda e: None)
+        log_period = _flags.get_flag("log_period")
+        for pass_id in range(start_pass, num_passes):
+            event_handler(BeginPass(pass_id))
+            evals = self._make_evaluators()
+            costs = []
+            for batch_id, raw in enumerate(reader()):
+                event_handler(BeginIteration(pass_id, batch_id))
+                feed = feeder(raw)
+                rng = _rng.split_for_step(self.step_key, self.global_step)
+                with GLOBAL_STATS.timer("train_step"):
+                    (
+                        self.params,
+                        self.opt_state,
+                        self.state,
+                        loss,
+                        outs,
+                    ) = self.step_fn(
+                        self.params,
+                        self.opt_state,
+                        self.state,
+                        feed,
+                        self.global_step,
+                        rng,
+                    )
+                cost = float(loss)
+                costs.append(cost)
+                for ev in evals:
+                    ev.add_batch(outs, feed)
+                self.global_step += 1
+                results = (
+                    {ev.name: ev.result() for ev in evals}
+                    if (batch_id + 1) % log_period == 0
+                    else {}
+                )
+                event_handler(
+                    EndIteration(pass_id, batch_id, cost, results)
+                )
+                if (batch_id + 1) % log_period == 0:
+                    log.info(
+                        "pass %d batch %d cost %.5f %s",
+                        pass_id,
+                        batch_id,
+                        float(np.mean(costs[-log_period:])),
+                        results,
+                    )
+            results = {ev.name: ev.result() for ev in evals}
+            if test_reader is not None:
+                tr = self.test(test_reader, feeder)
+                event_handler(
+                    TestResult(pass_id, tr["cost"], tr["evaluators"])
+                )
+            if save_dir:
+                ckpt.save_pass(
+                    save_dir,
+                    pass_id,
+                    jax.device_get(self.params),
+                    jax.device_get(self.opt_state),
+                    jax.device_get(self.state),
+                    meta={"global_step": self.global_step},
+                    save_only_one=_flags.get_flag("save_only_one"),
+                )
+            event_handler(EndPass(pass_id, results))
+
+    def test(self, reader: Callable, feeder: Callable) -> dict:
+        """Evaluation pass (reference: trainer/Tester.h)."""
+        evals = self._make_evaluators()
+        costs = []
+        n = 0
+        for raw in reader():
+            feed = feeder(raw)
+            outs, batch_costs = self._eval_forward(feed)
+            costs.append(float(np.mean([np.mean(c) for c in batch_costs])))
+            for ev in evals:
+                ev.add_batch(outs, feed)
+            n += 1
+        return {
+            "cost": float(np.mean(costs)) if costs else float("nan"),
+            "evaluators": {ev.name: ev.result() for ev in evals},
+        }
+
+    def resume(self, save_dir: str, pass_id: int = -1) -> int:
+        """Load a checkpoint; returns the next pass id (start_pass
+        semantics of trainer/ParamUtil.h)."""
+        params, opt_state, state, meta = ckpt.load_pass(save_dir, pass_id)
+        self.params = {k: jax.numpy.asarray(v) for k, v in params.items()}
+        if opt_state is not None:
+            self.opt_state = jax.tree_util.tree_map(
+                jax.numpy.asarray, opt_state
+            )
+        if state is not None:
+            self.state = jax.tree_util.tree_map(jax.numpy.asarray, state)
+        self.params, self.opt_state, self.state = self.step_fn.place(
+            self.params, self.opt_state, self.state
+        )
+        self.global_step = meta.get("global_step", 0)
+        return meta["pass_id"] + 1
+
+
+class Inferencer:
+    """Inference runner (reference: python/paddle/v2/inference.py:9,93 and
+    the C-API serving path capi/gradient_machine.h:73): load a merged
+    model or pass (net, params), jit the forward, return numpy outputs."""
+
+    def __init__(self, net: Network, params: dict, state=None, outputs=None):
+        self.net = net
+        self.params = params
+        self.state = state or net.init_state()
+        self.output_names = outputs or net.output_names
+
+        def fwd(params, state, feed):
+            outs, _ = self.net.forward(
+                params, feed, state=state, train=False,
+                outputs=self.output_names,
+            )
+            return {n: outs[n] for n in self.output_names}
+
+        self._fwd = jax.jit(fwd)
+
+    @classmethod
+    def from_merged(cls, path: str, outputs=None):
+        conf, params, state = ckpt.load_merged(path)
+        return cls(Network(conf), params, state, outputs)
+
+    def infer(self, feed: dict) -> dict:
+        outs = self._fwd(self.params, self.state, feed)
+        return {
+            n: np.asarray(a.value if a.value is not None else a.ids)
+            for n, a in outs.items()
+        }

@@ -1,0 +1,156 @@
+"""Trainer end-to-end: readers -> feeder -> SGD loop -> checkpoint ->
+inference (reference: test_TrainerOnePass.cpp one-pass cost sanity +
+v2 trainer/parameters tests)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu import dsl
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.data import reader as rd
+from paddle_tpu.data.feeder import DataFeeder, dense_vector, integer_value
+from paddle_tpu.network import Network
+from paddle_tpu.trainer import EndIteration, EndPass, SGD
+from paddle_tpu.trainer.checkpoint import load_merged, merge_model
+from paddle_tpu.trainer.trainer import Inferencer
+
+
+def make_conf():
+    with dsl.model() as g:
+        x = dsl.data("x", (8,))
+        y = dsl.data("y", (1,), is_ids=True)
+        h = dsl.fc(x, size=16, act="tanh")
+        out = dsl.fc(h, size=3, name="output")
+        dsl.classification_cost(out, y)
+        g.conf.output_layer_names.append("output")
+    return g.conf
+
+
+def synth_reader(n=200, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((d, classes))
+    xs = rng.standard_normal((n, d)).astype(np.float32)
+    ys = np.argmax(xs @ w, axis=1).astype(np.int64)
+
+    def reader():
+        for i in range(n):
+            yield (xs[i], int(ys[i]))
+
+    return reader
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    save_dir = str(tmp_path_factory.mktemp("ckpt"))
+    conf = make_conf()
+    trainer = SGD(
+        conf,
+        OptimizationConf(learning_method="adam", learning_rate=0.02,
+                         batch_size=20),
+        evaluators=[{"type": "classification_error", "name": "err",
+                     "input": "output", "label": "y"}],
+        seed=3,
+    )
+    feeder = DataFeeder({"x": 0, "y": 1},
+                        {"x": dense_vector(8), "y": integer_value(3)})
+    batches = rd.batched(rd.shuffle(synth_reader(), 200, seed=1), 20)
+    events = {"end_iter": 0, "end_pass": []}
+
+    def handler(e):
+        if isinstance(e, EndIteration):
+            events["end_iter"] += 1
+        elif isinstance(e, EndPass):
+            events["end_pass"].append(e.evaluator_results)
+
+    trainer.train(
+        reader=batches, feeder=feeder, num_passes=4,
+        event_handler=handler, save_dir=save_dir,
+    )
+    return conf, trainer, feeder, events, save_dir
+
+
+def test_training_improves(trained):
+    conf, trainer, feeder, events, save_dir = trained
+    assert events["end_iter"] == 4 * 10
+    errs = [p["err"] for p in events["end_pass"]]
+    assert errs[-1] < 0.15, f"final error too high: {errs}"
+
+
+def test_test_pass(trained):
+    conf, trainer, feeder, events, save_dir = trained
+    batches = rd.batched(synth_reader(seed=0), 20)
+    res = trainer.test(batches, feeder)
+    assert res["cost"] < 0.6
+
+
+def test_checkpoint_roundtrip(trained):
+    conf, trainer, feeder, events, save_dir = trained
+    from paddle_tpu.core.config import OptimizationConf as OC
+
+    assert os.path.isdir(os.path.join(save_dir, "pass-00003"))
+    t2 = SGD(conf, OC(learning_method="adam", learning_rate=0.02), seed=99)
+    next_pass = t2.resume(save_dir)
+    assert next_pass == 4
+    batches = rd.batched(synth_reader(seed=0), 20)
+    r1 = trainer.test(batches, feeder)
+    r2 = t2.test(batches, feeder)
+    assert abs(r1["cost"] - r2["cost"]) < 1e-5
+
+
+def test_merged_model_inference(trained, tmp_path):
+    conf, trainer, feeder, events, save_dir = trained
+    import jax
+
+    path = str(tmp_path / "model.npz")
+    merge_model(path, conf, jax.device_get(trainer.params),
+                jax.device_get(trainer.state))
+    inf = Inferencer.from_merged(path)
+    batch = list(synth_reader(n=40)())
+    feed = feeder(batch)
+    out = inf.infer({"x": feed["x"]})["output"]
+    labels = np.asarray([b[1] for b in batch])
+    acc = (np.argmax(out, axis=1) == labels).mean()
+    assert acc > 0.85
+
+
+def test_reader_combinators():
+    r = rd.np_array(list(range(10)))
+    assert list(rd.firstn(r, 3)()) == [0, 1, 2]
+    assert sorted(rd.shuffle(r, 5, seed=0)()) == list(range(10))
+    assert list(rd.chain(r, r)()) == list(range(10)) * 2
+    assert list(rd.map_readers(lambda a: a * 2, r)()) == [x * 2 for x in range(10)]
+    assert list(rd.buffered(r, 4)()) == list(range(10))
+    c = rd.compose(r, r)
+    assert list(c())[0] == (0, 0)
+    b = list(rd.batched(r, 3)())
+    assert b == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    b2 = list(rd.batched(r, 3, drop_last=False)())
+    assert b2[-1] == [9]
+
+
+def test_bucket_overflow_clear_error():
+    from paddle_tpu.data.feeder import DataFeeder, integer_value
+
+    f = DataFeeder({"w": 0}, {"w": integer_value(10, seq_type=1)},
+                   buckets=[4, 8])
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="largest bucket"):
+        f([(list(range(12)),)])
+
+
+def test_buffered_propagates_reader_errors():
+    def bad_reader():
+        yield 1
+        yield 2
+        raise RuntimeError("disk died")
+
+    import pytest as _pytest
+
+    got = []
+    with _pytest.raises(RuntimeError, match="disk died"):
+        for x in rd.buffered(lambda: bad_reader(), 4)():
+            got.append(x)
+    assert got == [1, 2]
