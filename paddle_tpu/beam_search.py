@@ -1,0 +1,215 @@
+"""Beam-search sequence generation.
+
+Reference: RecurrentGradientMachine::generateSequence + beamSearch
+(gserver/gradientmachines/RecurrentGradientMachine.h:307,309, .cpp) and
+the SWIG SequenceGenerator (api/SequenceGenerator.cpp). There, generation
+walks frame nets step-by-step on a dynamically shrinking batch of live
+beams. TPU-first: fixed [B, K] beam layout scanned to max_length with
+finished-beam masking — one compiled program, no dynamic batch.
+
+The step net is authored with the same DSL as recurrent_group: a data
+layer for the previous word id, static links (encoder outputs etc.),
+memories for decoder state. Its output layer must produce a probability
+distribution [*, V] (softmax output).
+
+User-callback beam hooks (RecurrentGradientMachine.h:92-152) are covered
+by `logprob_fn`: an optional host-side-free JAX fn applied to the step's
+log-probs before expansion (e.g. masking illegal words).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import LayerConf, ModelConf
+from paddle_tpu.network import Network
+
+NEG_INF = -1e30
+
+
+class BeamSearchDecoder:
+    """Built from DSL pieces:
+
+        def step(word, enc):
+            emb = dsl.embedding(word, size=E, vocab_size=V, param=...)
+            prev = dsl.memory("s", size=H, boot_layer=enc_last)
+            s = dsl.fc(emb, prev, size=H, act="tanh", name="s")
+            return dsl.fc(s, size=V, act="softmax", name="prob")
+
+        dec = BeamSearchDecoder(step, n_static=1, bos_id=0, eos_id=1,
+                                beam_size=4, max_length=20)
+        seqs, lens, scores = dec.generate(params, statics=[enc_arg],
+                                          boots={"s": enc_last_value})
+    """
+
+    def __init__(
+        self,
+        step: Callable,
+        n_static: int,
+        bos_id: int,
+        eos_id: int,
+        beam_size: int,
+        max_length: int,
+        logprob_fn: Optional[Callable] = None,
+    ):
+        from paddle_tpu import dsl
+
+        self.bos_id, self.eos_id = bos_id, eos_id
+        self.k = beam_size
+        self.max_length = max_length
+        self.logprob_fn = logprob_fn
+
+        with dsl.model() as sub:
+            word = sub.add(
+                LayerConf(name="@word", type="data", size=1,
+                          attrs={"dim": (1,), "is_seq": False,
+                                 "is_ids": True})
+            )
+            statics = []
+            for i in range(n_static):
+                statics.append(
+                    sub.add(LayerConf(name=f"@static_{i}", type="data",
+                                      size=0,
+                                      attrs={"dim": (0,), "is_seq": False,
+                                             "is_ids": False}))
+                )
+            out = step(word, *statics)
+        self.step_conf: ModelConf = sub.conf
+        self.memories = sub.memories
+        self.out_name = out.name
+        self.static_links = [f"@static_{i}" for i in range(n_static)]
+        self._net: Optional[Network] = None
+
+    def _build(self, statics: list):
+        for i, a in enumerate(statics):
+            lc = self.step_conf.layer(self.static_links[i])
+            v = a.value if a.value is not None else a.ids
+            dim = tuple(v.shape[2:] if a.is_seq else v.shape[1:]) or (1,)
+            lc.attrs["dim"] = dim
+            lc.attrs["is_seq"] = a.is_seq
+            lc.attrs["is_ids"] = a.ids is not None
+        self._net = Network(self.step_conf)
+        return self._net
+
+    def param_confs(self, statics: list):
+        """Parameter table of the step net (names shared with training)."""
+        return self._build(statics).param_confs
+
+    def generate(self, params: dict, statics: list, boots: dict = None,
+                 batch_size: int = None):
+        """statics: list[Arg] (batch-major, B rows). boots: memory layer
+        name -> [B, size] boot value (overrides zeros/boot_value).
+        Returns (seqs [B, K, max_length] int32, lens [B, K], scores [B, K]),
+        beams sorted best-first."""
+        net = self._net or self._build(statics)
+        k = self.k
+        boots = boots or {}
+        if batch_size is not None:
+            b = batch_size
+        elif statics:
+            a0 = statics[0]
+            b = (a0.value if a0.value is not None else a0.ids).shape[0]
+        elif boots:
+            b = next(iter(boots.values())).shape[0]
+        else:
+            raise ValueError("generate() needs statics, boots, or batch_size")
+
+        def tile(x):
+            # [B, ...] -> [B*K, ...]
+            return jnp.repeat(x, k, axis=0)
+
+        static_feed = {}
+        for i, a in enumerate(statics):
+            static_feed[self.static_links[i]] = Arg(
+                value=None if a.value is None else tile(a.value),
+                ids=None if a.ids is None else tile(a.ids),
+                seq_lens=None if a.seq_lens is None else tile(a.seq_lens),
+            )
+
+        init_carry_mem = {}
+        for m in self.memories:
+            if m["layer"] in boots:
+                init_carry_mem[m["layer"]] = tile(boots[m["layer"]])
+            elif m.get("boot_layer"):
+                raise ValueError(
+                    f"memory {m['layer']!r} declares boot_layer="
+                    f"{m['boot_layer']!r}, but generate() cannot compute "
+                    f"parent layers — pass boots={{{m['layer']!r}: value}} "
+                    f"with that layer's [B, {m['size']}] output"
+                )
+            else:
+                init_carry_mem[m["layer"]] = jnp.full(
+                    (b * k, m["size"]), m.get("boot_value", 0.0), jnp.float32
+                )
+
+        def body(carry, _):
+            mems, words, scores, finished, t = carry
+            feed = dict(static_feed)
+            feed["@word"] = Arg(ids=words.reshape(b * k))
+            for m in self.memories:
+                feed[m["link"]] = Arg(value=mems[m["layer"]])
+            outs, _ = net.forward(params, feed, train=False)
+            prob = outs[self.out_name].value  # [B*K, V]
+            v = prob.shape[-1]
+            logp = jnp.log(jnp.maximum(prob, 1e-20)).reshape(b, k, v)
+            if self.logprob_fn is not None:
+                logp = self.logprob_fn(logp, t)
+            # finished beams only extend with eos at no cost
+            fin_row = jnp.full((v,), NEG_INF).at[self.eos_id].set(0.0)
+            logp = jnp.where(finished[..., None], fin_row[None, None, :], logp)
+            cand = scores[..., None] + logp  # [B,K,V]
+            flat = cand.reshape(b, k * v)
+            top_scores, top_idx = jax.lax.top_k(flat, k)  # [B,K]
+            parent = top_idx // v  # [B,K]
+            word = (top_idx % v).astype(jnp.int32)
+            # reorder memories by parent beam
+            new_mems = {}
+            for m in self.memories:
+                mm = outs[m["layer"]].value.reshape(b, k, -1)
+                sel = jnp.take_along_axis(mm, parent[..., None], axis=1)
+                prev = mems[m["layer"]].reshape(b, k, -1)
+                prev_sel = jnp.take_along_axis(prev, parent[..., None], axis=1)
+                was_fin = jnp.take_along_axis(finished, parent, axis=1)
+                keep = was_fin[..., None]
+                new_mems[m["layer"]] = jnp.where(
+                    keep, prev_sel, sel
+                ).reshape(b * k, -1)
+            was_fin = jnp.take_along_axis(finished, parent, axis=1)
+            new_fin = was_fin | (word == self.eos_id)
+            return (
+                (new_mems, word, top_scores, new_fin, t + 1),
+                (word, parent, new_fin),
+            )
+
+        words0 = jnp.full((b, k), self.bos_id, jnp.int32)
+        scores0 = jnp.full((b, k), NEG_INF).at[:, 0].set(0.0)
+        fin0 = jnp.zeros((b, k), bool)
+        carry0 = (init_carry_mem, words0, scores0, fin0, jnp.int32(0))
+        (mems, words, scores, finished, _), (ws, ps, fs) = jax.lax.scan(
+            body, carry0, None, length=self.max_length
+        )
+        # backtrace beam parents to recover sequences
+        t = self.max_length
+
+        def back(nxt_parent, step_out):
+            w_t, p_t, _ = step_out
+            w = jnp.take_along_axis(w_t, nxt_parent, axis=1)
+            p = jnp.take_along_axis(p_t, nxt_parent, axis=1)
+            return p, w
+
+        last_parent = jnp.broadcast_to(
+            jnp.arange(k, dtype=jnp.int32)[None, :], (b, k)
+        )
+        _, seq_rev = jax.lax.scan(back, last_parent, (ws, ps, fs),
+                                  reverse=True)
+        seqs = seq_rev.transpose(1, 2, 0)  # [B,K,T]
+        # length = position of first eos + 1 (or max_length)
+        is_eos = seqs == self.eos_id
+        any_eos = jnp.any(is_eos, axis=-1)
+        first_eos = jnp.argmax(is_eos, axis=-1)
+        lens = jnp.where(any_eos, first_eos + 1, t).astype(jnp.int32)
+        return seqs, lens, scores

@@ -36,6 +36,7 @@ from paddle_tpu.core.config import (
 class GraphBuilder:
     conf: ModelConf = field(default_factory=ModelConf)
     _counts: dict = field(default_factory=dict)
+    memories: list = field(default_factory=list)  # recurrent-group steps
 
     def uniq(self, prefix: str) -> str:
         n = self._counts.get(prefix, 0)
@@ -136,6 +137,11 @@ def concat(*inputs, name=None):
 
 def cos_sim(a, b, scale=1.0, name=None):
     return _add("cos", [a, b], name=name, scale=scale)
+
+
+def scaling(weight, x, name=None):
+    """Per-row scalar weight times vector x (ScalingLayer)."""
+    return _add("scaling", [weight, x], name=name)
 
 
 def dropout(x, rate, name=None):
@@ -269,6 +275,103 @@ def seq_concat(a, b, name=None):
 
 def seq_reverse(x, name=None):
     return _add("seqreverse", [x], name=name)
+
+
+# ---- recurrent groups (trainer_config_helpers/layers.py memory:3160,
+# recurrent_group:3610; executor in layers/recurrent_group.py) ----
+
+
+class StaticInput:
+    """Read-only per-sequence input to a recurrent group — the reference's
+    StaticInput: a non-sliced value visible whole at every step (e.g. the
+    encoder sequence for attention)."""
+
+    def __init__(self, ref):
+        self.ref = ref
+
+
+def memory(name, size, boot_layer=None, boot_value=0.0):
+    """Inside a recurrent_group step: the value the step-layer `name` had
+    at t-1 (boot at t=0). Mirrors trainer_config_helpers memory()."""
+    g = current()
+    link = f"@mem_{name}"
+    g.add(
+        LayerConf(
+            name=link, type="data", size=size,
+            attrs={"dim": (size,), "is_seq": False, "is_ids": False},
+        )
+    )
+    g.memories.append(
+        {
+            "layer": name,
+            "link": link,
+            "boot_layer": boot_layer.name if boot_layer is not None else None,
+            "boot_value": boot_value,
+            "size": size,
+        }
+    )
+    return LayerRef(link, g)
+
+
+def recurrent_group(step, inputs, name=None, reversed=False):
+    """Build a scanned step network. `inputs`: LayerRefs (sequence
+    in-links, sliced per step) and/or StaticInput(ref). `step` receives
+    one LayerRef per input (in order) and returns the output LayerRef
+    (or tuple; first is the group's output)."""
+    parent = current()
+    name = name or parent.uniq("recurrent_group")
+    seq_ins = [x for x in inputs if not isinstance(x, StaticInput)]
+    stat_ins = [x.ref for x in inputs if isinstance(x, StaticInput)]
+    # share the parent's name counters so auto-named step layers can never
+    # collide with auto-named parent layers (one config namespace, as in
+    # the reference where group layers live inside the global ModelConfig)
+    with model() as sub:
+        sub._counts = parent._counts
+        step_args = []
+        in_links, static_links = [], []
+        for i in range(len(seq_ins)):
+            ln = f"@in_{i}"
+            sub.add(LayerConf(name=ln, type="data", size=0,
+                              attrs={"dim": (0,), "is_seq": False,
+                                     "is_ids": False}))
+            in_links.append(ln)
+        for i in range(len(stat_ins)):
+            ln = f"@static_{i}"
+            sub.add(LayerConf(name=ln, type="data", size=0,
+                              attrs={"dim": (0,), "is_seq": False,
+                                     "is_ids": False}))
+            static_links.append(ln)
+        it_seq = iter(in_links)
+        it_static = iter(static_links)
+        for x in inputs:
+            ln = next(it_static) if isinstance(x, StaticInput) else next(it_seq)
+            step_args.append(LayerRef(ln, sub))
+        out = step(*step_args)
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    boot_layers = [
+        m["boot_layer"] for m in sub.memories if m["boot_layer"] is not None
+    ]
+    lc = LayerConf(
+        name=name,
+        type="recurrent_group",
+        size=0,
+        inputs=[InputConf(r.name) for r in seq_ins]
+        + [InputConf(r.name) for r in stat_ins]
+        + [InputConf(n) for n in boot_layers],
+        attrs={
+            "step_conf": sub.conf,
+            "in_links": in_links,
+            "static_links": static_links,
+            "memories": sub.memories,
+            "out_links": [o.name for o in outs],
+            "reversed": reversed,
+        },
+    )
+    ref = parent.add(lc)
+    if isinstance(out, (tuple, list)):
+        # secondary out_links surface under their step-layer names
+        return (ref,) + tuple(LayerRef(o.name, parent) for o in outs[1:])
+    return ref
 
 
 # ---- costs ----

@@ -8,5 +8,8 @@ from paddle_tpu.layers import (  # noqa: F401
     norm,
     pool,
     recurrent,
+    recurrent_group,
+    sampling,
     sequence,
+    structured,
 )

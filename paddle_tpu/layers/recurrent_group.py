@@ -1,0 +1,205 @@
+"""Recurrent group: a user-defined step network scanned over time.
+
+Reference: the RecurrentGradientMachine
+(gserver/gradientmachines/RecurrentGradientMachine.{h,cpp}, 1455 LoC) plus
+its config plumbing (RecurrentLayerGroup.cpp, AgentLayer.cpp,
+proto SubModelConfig ModelConfig.proto:579) and the DSL front-end
+(trainer_config_helpers/layers.py memory:3160, recurrent_group:3610).
+
+The reference builds one frame network per timestep and walks them
+sequentially, wiring memory agents frame(t-1)->frame(t). TPU-first
+redesign: the step net is built ONCE as a sub-Network of pure functions
+and driven by `lax.scan`; memories are scan carries with masked
+carry-through on padding; in-links are time slices; static links are
+closed over (read-only per-sequence inputs, including full encoder
+sequences for attention). XLA compiles the whole loop as one fused
+while-op — no per-frame graph rebuilding.
+
+Group layer conf:
+  inputs: [in_links..., static_links..., boot_layers...]
+  attrs:
+    step_conf    — nested ModelConf (JSON dict) of the step net
+    in_links     — step data-layer name per sliced sequence input
+    static_links — step data-layer name per static input
+    memories     — [{"layer": producer-in-step, "link": step data name,
+                    "boot_layer": parent input name | None,
+                    "boot_value": float, "size": int}]
+    out_links    — step layer names to emit as sequences
+    reversed     — scan right-to-left
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import ModelConf, _model_from_dict
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Ctx, Layer, Spec
+from paddle_tpu.ops import sequence_ops as sops
+
+
+@LAYERS.register("recurrent_group", "recurrent_layer_group")
+class RecurrentGroupLayer(Layer):
+    def build(self, in_specs):
+        from paddle_tpu.network import Network  # cycle-free late import
+
+        a = self.conf.attrs
+        step_conf = a["step_conf"]
+        if isinstance(step_conf, dict):
+            step_conf = _model_from_dict(step_conf)
+        assert isinstance(step_conf, ModelConf)
+        self.in_links = list(a.get("in_links", []))
+        self.static_links = list(a.get("static_links", []))
+        self.memories = list(a.get("memories", []))
+        self.out_links = list(a.get("out_links", []))
+        self.reversed = a.get("reversed", False)
+
+        n_in = len(self.in_links)
+        n_static = len(self.static_links)
+        self._in_specs = in_specs
+        boot_specs = in_specs[n_in + n_static:]
+
+        # fill step-net data layer dims from parent specs
+        for i, link in enumerate(self.in_links):
+            lc = step_conf.layer(link)
+            lc.attrs["dim"] = tuple(in_specs[i].dim)
+            lc.attrs["is_seq"] = False
+            lc.attrs["is_ids"] = in_specs[i].is_ids
+        for i, link in enumerate(self.static_links):
+            s = in_specs[n_in + i]
+            lc = step_conf.layer(link)
+            lc.attrs["dim"] = tuple(s.dim)
+            lc.attrs["is_seq"] = s.is_seq
+            lc.attrs["is_ids"] = s.is_ids
+        for m in self.memories:
+            lc = step_conf.layer(m["link"])
+            lc.attrs["dim"] = (m["size"],)
+            lc.attrs["is_seq"] = False
+
+        self.step_net = Network(step_conf)
+        self._boot_specs = boot_specs
+        # Expose the step net's params as this layer's: names merge into
+        # the parent param table, giving sharing-by-name as in the
+        # reference. Params of AUTO-named step layers (dsl `__fc_0__`
+        # style) are prefixed with the group name — per-builder uniq
+        # counters restart inside the step context, so without the prefix
+        # an unnamed parent layer of the same shape would silently share
+        # weights with an unrelated step layer.
+        renames = {
+            old: f"_{self.name}.{old}"
+            for old in self.step_net.param_confs
+            if old.startswith("___")
+        }
+        for old, new in renames.items():
+            pc = self.step_net.param_confs.pop(old)
+            pc.name = new
+            self.step_net.param_confs[new] = pc
+        for slot_map in self.step_net.layer_params.values():
+            for slot, g in list(slot_map.items()):
+                if g in renames:
+                    slot_map[slot] = renames[g]
+        pcs = dict(self.step_net.param_confs)
+        out_spec = self.step_net.specs[self.out_links[0]]
+        self._out_specs = [self.step_net.specs[o] for o in self.out_links]
+        return (
+            Spec(dim=out_spec.dim, is_seq=True, is_ids=out_spec.is_ids),
+            pcs,
+        )
+
+    def extra_output_specs(self):
+        """Secondary out_links, registered by Network under their step-net
+        layer names so parent layers can consume them."""
+        return {
+            o: Spec(dim=s.dim, is_seq=True, is_ids=s.is_ids)
+            for o, s in zip(self.out_links[1:], self._out_specs[1:])
+        }
+
+    def _boot(self, m, inputs, bsz, dtype):
+        n_in = len(self.in_links)
+        n_static = len(self.static_links)
+        if m.get("boot_layer"):
+            # boot layer is one of the trailing parent inputs
+            names = [ic.name for ic in self.conf.inputs[n_in + n_static:]]
+            idx = names.index(m["boot_layer"])
+            return inputs[n_in + n_static + idx].value
+        return jnp.full((bsz, m["size"]), m.get("boot_value", 0.0), dtype)
+
+    def forward(self, params, inputs, ctx):
+        n_in = len(self.in_links)
+        n_static = len(self.static_links)
+        seq_arg = inputs[0]
+        assert seq_arg.is_seq, "recurrent_group first in_link must be a sequence"
+        bsz, t = seq_arg.batch, seq_arg.max_len
+        dtype = jnp.float32
+        seq_lens = seq_arg.seq_lens
+
+        # sliced sequence inputs, time-major
+        xs_vals = []
+        for i in range(n_in):
+            a = inputs[i]
+            v = a.ids if a.ids is not None else a.value
+            if self.reversed:
+                v = sops.reverse_seq(v, seq_lens)
+            xs_vals.append(v.swapaxes(0, 1))  # [T,B,...]
+        mask_tb = (
+            jnp.arange(t, dtype=jnp.int32)[None, :] < seq_lens[:, None]
+        ).astype(dtype).swapaxes(0, 1)  # [T,B]
+
+        static_feed = {}
+        for i, link in enumerate(self.static_links):
+            static_feed[link] = inputs[n_in + i]
+
+        init_carry = {
+            m["layer"]: self._boot(m, inputs, bsz, dtype)
+            for m in self.memories
+        }
+
+        def body(carry, inp):
+            m_t = inp[-1]
+            feed = dict(static_feed)
+            for i, link in enumerate(self.in_links):
+                x_t = inp[i]
+                if self._in_specs[i].is_ids:
+                    feed[link] = Arg(ids=x_t)
+                else:
+                    feed[link] = Arg(value=x_t)
+            for m in self.memories:
+                feed[m["link"]] = Arg(value=carry[m["layer"]])
+            outs, _ = self.step_net.forward(
+                params, feed, train=ctx.train, rng=ctx.rng
+            )
+            new_carry = {}
+            for m in self.memories:
+                new_v = outs[m["layer"]].value
+                prev = carry[m["layer"]]
+                mm = m_t[:, None]
+                new_carry[m["layer"]] = mm * new_v + (1.0 - mm) * prev
+            ys = []
+            for o in self.out_links:
+                out_a = outs[o]
+                y = out_a.ids if out_a.ids is not None else out_a.value
+                if y.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+                    y = y * m_t.reshape((bsz,) + (1,) * (y.ndim - 1)).astype(
+                        y.dtype
+                    )
+                ys.append(y)
+            return new_carry, tuple(ys)
+
+        xs = tuple(xs_vals) + (mask_tb,)
+        _, ys = jax.lax.scan(body, init_carry, xs)
+        outs = []
+        for i, y in enumerate(ys):
+            y = y.swapaxes(0, 1)  # [B,T,...]
+            if self.reversed:
+                y = sops.reverse_seq(y, seq_lens)
+            spec = self._out_specs[i]
+            if spec.is_ids:
+                outs.append(Arg(ids=y, seq_lens=seq_lens))
+            else:
+                outs.append(Arg(value=y, seq_lens=seq_lens))
+        self._extra_outs = {
+            o: outs[i] for i, o in enumerate(self.out_links[1:], start=1)
+        }
+        return outs[0]

@@ -1,0 +1,169 @@
+"""Sampling-based output layers: NCE, hierarchical sigmoid, sampling_id.
+
+Reference: gserver/layers/{NCELayer,HierarchicalSigmoidLayer,
+SamplingIdLayer,MultinomialSampler}.cpp. Sampling uses JAX's counter-based
+PRNG (no alias-table MultinomialSampler needed —
+jax.random.categorical is the device-side equivalent).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.layers.cost import CostLayerBase
+
+
+@LAYERS.register("nce")
+class NCELayer(CostLayerBase):
+    """Noise-contrastive estimation (NCELayer.cpp). inputs:
+    [feature(s)..., label(ids)]. attrs: num_classes, num_neg_samples
+    (default 10), neg_distribution (optional list of class probs).
+    Params per feature input: W_i [num_classes, dim_i]; bias [num_classes].
+
+    Training uses sampled logistic losses; at test time
+    (ctx.train=False) it returns the same sampled objective with a fixed
+    key so costs are deterministic."""
+
+    def build(self, in_specs):
+        nc = self.conf.attrs["num_classes"]
+        pcs = {}
+        self._feat_specs = in_specs[:-1]
+        for i, s in enumerate(in_specs[:-1]):
+            pcs[f"w{i}"] = self.weight_conf(i, (nc, s.size))
+        b = self.bias_conf((nc,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(1,), is_seq=False), pcs
+
+    def forward(self, params, inputs, ctx):
+        a = self.conf.attrs
+        nc = a["num_classes"]
+        k = a.get("num_neg_samples", 10)
+        label = inputs[-1]
+        feats = inputs[:-1]
+        bsz = label.ids.shape[0]
+
+        neg_dist = a.get("neg_distribution")
+        if neg_dist is not None:
+            logq = jnp.log(jnp.asarray(neg_dist, jnp.float32) + 1e-20)
+        else:
+            logq = jnp.full((nc,), -np.log(nc), jnp.float32)
+
+        key = ctx.split(self.name) if ctx.train else jax.random.key(0)
+        neg = jax.random.categorical(key, logq, shape=(bsz, k))  # [B,k]
+
+        def score(cls_idx):
+            """cls_idx: [B, m] -> scores [B, m]."""
+            s = 0.0
+            for i, f in enumerate(feats):
+                w = params[f"w{i}"]  # [nc, d]
+                rows = jnp.take(w, cls_idx, axis=0)  # [B,m,d]
+                x = f.value.reshape(bsz, -1)
+                s = s + jnp.einsum("bd,bmd->bm", x, rows)
+            if "b" in params:
+                s = s + jnp.take(params["b"], cls_idx)
+            return s
+
+        pos_s = score(label.ids[:, None])[:, 0]  # [B]
+        neg_s = score(neg)  # [B,k]
+        logk = jnp.log(float(k))
+        pos_logit = pos_s - (logk + jnp.take(logq, label.ids))
+        neg_logit = neg_s - (logk + jnp.take(logq, neg))
+        loss = jax.nn.softplus(-pos_logit) + jnp.sum(
+            jax.nn.softplus(neg_logit), axis=1
+        )
+        return self._reduce(loss, feats[0])
+
+
+@LAYERS.register("hsigmoid")
+class HierarchicalSigmoidLayer(CostLayerBase):
+    """Hierarchical sigmoid over a complete binary tree
+    (HierarchicalSigmoidLayer.cpp): class c's path is the bit pattern of
+    (c + num_classes); internal node j has weight row j-1. Params per
+    feature input: W_i [num_classes-1, dim_i]; bias [num_classes-1]."""
+
+    def build(self, in_specs):
+        nc = self.conf.attrs["num_classes"]
+        self._depth = int(np.ceil(np.log2(nc))) + 1
+        pcs = {}
+        for i, s in enumerate(in_specs[:-1]):
+            pcs[f"w{i}"] = self.weight_conf(i, (nc - 1, s.size))
+        b = self.bias_conf((nc - 1,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(1,), is_seq=False), pcs
+
+    def forward(self, params, inputs, ctx):
+        nc = self.conf.attrs["num_classes"]
+        label = inputs[-1]
+        feats = inputs[:-1]
+        bsz = label.ids.shape[0]
+
+        code = label.ids + nc  # [B]
+        loss = jnp.zeros((bsz,), jnp.float32)
+        for _ in range(self._depth):
+            parent = code // 2
+            bit = (code % 2).astype(jnp.float32)  # 1 = right child
+            node = parent - 1  # weight row
+            active = parent >= 1
+            safe_node = jnp.clip(node, 0, nc - 2)
+            s = jnp.zeros((bsz,), jnp.float32)
+            for i, f in enumerate(feats):
+                w_rows = jnp.take(params[f"w{i}"], safe_node, axis=0)
+                s = s + jnp.einsum("bd,bd->b", f.value.reshape(bsz, -1), w_rows)
+            if "b" in params:
+                s = s + jnp.take(params["b"], safe_node)
+            # binary logistic: target bit
+            step_loss = jax.nn.softplus(jnp.where(bit > 0, -s, s))
+            loss = loss + jnp.where(active, step_loss, 0.0)
+            code = parent
+        return self._reduce(loss, feats[0])
+
+
+@LAYERS.register("sampling_id")
+class SamplingIdLayer(Layer):
+    """Sample an id from a probability row (SamplingIdLayer.cpp)."""
+
+    def build(self, in_specs):
+        return Spec(dim=(1,), is_seq=in_specs[0].is_seq, is_ids=True), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        key = ctx.split(self.name)
+        logits = jnp.log(jnp.maximum(arg.value, 1e-20))
+        ids = jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+        return Arg(ids=ids, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("max_id")
+class MaxIdLayer(Layer):
+    """Argmax id (MaxIdLayer.cpp)."""
+
+    def build(self, in_specs):
+        return Spec(dim=(1,), is_seq=in_specs[0].is_seq, is_ids=True), {}
+
+    def forward(self, params, inputs, ctx):
+        (arg,) = inputs
+        ids = jnp.argmax(arg.value, axis=-1).astype(jnp.int32)
+        return Arg(ids=ids, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("multiplex")
+class MultiplexLayer(Layer):
+    """Row-wise select among N inputs by index input
+    (MultiplexLayer.cpp). inputs: [selector(ids), x1..xN]."""
+
+    def build(self, in_specs):
+        return in_specs[1], {}
+
+    def forward(self, params, inputs, ctx):
+        sel = inputs[0].ids
+        stacked = jnp.stack([a.value for a in inputs[1:]], axis=0)  # [N,B,...]
+        idx = sel.reshape((1, -1) + (1,) * (stacked.ndim - 2))
+        y = jnp.take_along_axis(stacked, idx, axis=0)[0]
+        return inputs[1].with_value(y)

@@ -1,0 +1,89 @@
+"""Structured-prediction layers: CRF, CRF decoding, CTC.
+
+Reference: gserver/layers/{CRFLayer,CRFDecodingLayer,CTCLayer,
+WarpCTCLayer}.cpp. The CRF transition parameter is a trainable weight of
+shape [num_tags+2, num_tags] exactly like LinearChainCRF.cpp; CTC has no
+parameters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.layers.cost import CostLayerBase
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import ctc as ctc_ops
+
+
+@LAYERS.register("crf")
+class CRFLayer(CostLayerBase):
+    """Linear-chain CRF negative log-likelihood (CRFLayer.cpp).
+    inputs: [emission(seq [B,T,N]), label(seq ids)]. size = num_tags."""
+
+    def build(self, in_specs):
+        n = self.conf.size or in_specs[0].size
+        self._num_tags = n
+        pcs = {"w0": self.weight_conf(0, (n + 2, n))}
+        return Spec(dim=(1,), is_seq=False), pcs
+
+    def forward(self, params, inputs, ctx):
+        emit, label = inputs
+        ll = crf_ops.crf_log_likelihood(
+            emit.value, label.ids, emit.seq_lens, params["w0"]
+        )
+        return Arg(value=self.conf.attrs.get("coeff", 1.0) * (-ll))
+
+
+@LAYERS.register("crf_decoding")
+class CRFDecodingLayer(Layer):
+    """Viterbi decode (CRFDecodingLayer.cpp). inputs: [emission] (+ optional
+    label -> emits 0/1 error per token instead, like the reference)."""
+
+    def build(self, in_specs):
+        n = self.conf.size or in_specs[0].size
+        pcs = {"w0": self.weight_conf(0, (n + 2, n))}
+        out_dim = (1,)
+        return Spec(dim=out_dim, is_seq=True, is_ids=True), pcs
+
+    def forward(self, params, inputs, ctx):
+        emit = inputs[0]
+        paths, _ = crf_ops.crf_decode(emit.value, emit.seq_lens, params["w0"])
+        if len(inputs) > 1:
+            label = inputs[1]
+            err = (paths != label.ids).astype(jnp.float32)[..., None]
+            return Arg(value=err, seq_lens=emit.seq_lens)
+        return Arg(ids=paths, seq_lens=emit.seq_lens)
+
+
+@LAYERS.register("ctc", "warp_ctc")
+class CTCLayer(CostLayerBase):
+    """CTC loss (CTCLayer.cpp / WarpCTCLayer.cpp). inputs:
+    [logits or probs (seq [B,T,C]), label (seq ids)]. attrs:
+    blank (default 0), norm_by_times, apply_softmax (default True:
+    input is pre-softmax logits, as warpctc expects)."""
+
+    def build(self, in_specs):
+        return Spec(dim=(1,), is_seq=False), {}
+
+    def forward(self, params, inputs, ctx):
+        logits, label = inputs
+        a = self.conf.attrs
+        lp = (
+            jax.nn.log_softmax(logits.value, axis=-1)
+            if a.get("apply_softmax", True)
+            else jnp.log(jnp.maximum(logits.value, 1e-20))
+        )
+        nll = ctc_ops.ctc_loss(
+            lp,
+            logits.seq_lens,
+            label.ids,
+            label.seq_lens,
+            blank=a.get("blank", 0),
+        )
+        if a.get("norm_by_times", False):
+            nll = nll / jnp.maximum(logits.seq_lens, 1).astype(nll.dtype)
+        return Arg(value=self.conf.attrs.get("coeff", 1.0) * nll)

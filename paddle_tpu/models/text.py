@@ -51,3 +51,94 @@ def bidi_lstm_tagger(
         dsl.classification_cost(out, tags)
         g.conf.output_layer_names.append("output")
     return g.conf
+
+
+def _attention_decoder_step(hidden, trg_vocab, emb_dim):
+    """One decoder step: shared verbatim between the training
+    recurrent_group and the generation BeamSearchDecoder so all parameter
+    names line up (the reference reuses the SubModelConfig the same way:
+    RecurrentGradientMachine builds both training frames and generation
+    frames from one step net)."""
+    from paddle_tpu import dsl
+    from paddle_tpu.core.config import ParameterConf
+
+    def step(trg_word, enc):
+        emb = dsl.embedding(trg_word, size=emb_dim, vocab_size=trg_vocab,
+                            param=ParameterConf(name="trg_emb"),
+                            name="trg_emb_lookup")
+        prev = dsl.memory("dec_state", size=hidden)
+        # additive attention over the encoder sequence
+        # (networks.py:1298 simple_attention)
+        proj_s = dsl.fc(prev, size=hidden, bias=False, name="att_dec_proj")
+        expanded = dsl.expand(proj_s, enc, name="att_expand")
+        mix = dsl.addto(enc, expanded, act="tanh", name="att_mix")
+        scores = dsl.fc(mix, size=1, bias=False, act="sequence_softmax",
+                        name="att_score")
+        weighted = dsl.scaling(scores, enc, name="att_weighted")
+        ctx_vec = dsl.seq_pool(weighted, pool_type="sum", name="att_context")
+        s = dsl.fc(emb, prev, ctx_vec, size=hidden, act="tanh",
+                   name="dec_state")
+        return dsl.fc(s, size=trg_vocab, act="softmax", name="dec_prob")
+
+    return step
+
+
+def seq2seq_attention(
+    src_vocab=30000,
+    trg_vocab=30000,
+    emb_dim=128,
+    hidden=256,
+) -> ModelConf:
+    """Attention NMT trainer config (the quick_start seqToseq demo /
+    SURVEY.md north-star NMT). Teacher forcing: decoder consumes
+    `trg_in` (BOS-prefixed) and is scored against `trg_out` (EOS-suffixed).
+    Encoder hidden size = `hidden` (bidi concat of hidden/2 each)."""
+    from paddle_tpu import dsl
+    from paddle_tpu.core.config import InputConf, ParameterConf
+
+    step = _attention_decoder_step(hidden, trg_vocab, emb_dim)
+    with dsl.model() as g:
+        src = dsl.data("src", (1,), is_seq=True, is_ids=True)
+        trg_in = dsl.data("trg_in", (1,), is_seq=True, is_ids=True)
+        trg_out = dsl.data("trg_out", (1,), is_seq=True, is_ids=True)
+        src_emb = dsl.embedding(src, size=emb_dim, vocab_size=src_vocab,
+                                param=ParameterConf(name="src_emb"),
+                                name="src_emb_lookup")
+        fwd = dsl.simple_gru(src_emb, hidden // 2, name="enc_fwd")
+        bwd = dsl.simple_gru(src_emb, hidden // 2, name="enc_bwd",
+                             reversed=True)
+        enc = dsl.concat(fwd, bwd, name="enc")
+        # backward GRU's output at t=0 has processed the whole source
+        # (its scan runs right-to-left and is re-reversed to time order)
+        enc_summary = dsl.first_seq(bwd, name="enc_summary")
+        boot = dsl.fc(enc_summary, size=hidden, act="tanh", name="dec_boot")
+        prob = dsl.recurrent_group(
+            step, [trg_in, dsl.StaticInput(enc)], name="decoder"
+        )
+        dsl.cross_entropy(prob, trg_out, name="cost")
+        g.conf.output_layer_names.append("decoder")
+    # wire the decoder-state boot to the parent layer
+    rg = g.conf.layer("decoder")
+    for m in rg.attrs["memories"]:
+        if m["layer"] == "dec_state":
+            m["boot_layer"] = "dec_boot"
+    rg.inputs.append(InputConf("dec_boot"))
+    return g.conf
+
+
+def seq2seq_attention_decoder(
+    trg_vocab=30000,
+    emb_dim=128,
+    hidden=256,
+    bos_id=0,
+    eos_id=1,
+    beam_size=4,
+    max_length=50,
+):
+    """Generation decoder sharing parameter names with
+    seq2seq_attention (use the trained params dict directly)."""
+    from paddle_tpu.beam_search import BeamSearchDecoder
+
+    step = _attention_decoder_step(hidden, trg_vocab, emb_dim)
+    return BeamSearchDecoder(step, n_static=1, bos_id=bos_id, eos_id=eos_id,
+                             beam_size=beam_size, max_length=max_length)

@@ -28,6 +28,7 @@ class Network:
         self.conf = conf
         self.layers = {}
         self.specs = {}
+        self._extra_producer: dict[str, str] = {}
         self.param_confs: dict[str, ParameterConf] = {}  # global name -> conf
         self.layer_params: dict[str, dict] = {}  # layer -> {slot: global name}
         self._stateful: dict[str, object] = {}
@@ -60,6 +61,15 @@ class Network:
             self.layer_params[lc.name] = slot_map
             if hasattr(layer, "init_state"):
                 self._stateful[lc.name] = layer
+            if hasattr(layer, "extra_output_specs"):
+                for xname, xspec in layer.extra_output_specs().items():
+                    if xname in self.specs:
+                        raise KeyError(
+                            f"extra output {xname!r} of layer {lc.name!r} "
+                            f"collides with an existing layer name"
+                        )
+                    self.specs[xname] = xspec
+                    self._extra_producer[xname] = lc.name
             order.append(lc.name)
         self.order = order
         self.output_names = list(conf.output_layer_names) or (
@@ -114,6 +124,7 @@ class Network:
             frontier = list(outputs)
             while frontier:
                 n = frontier.pop()
+                n = self._extra_producer.get(n, n)  # extra out -> its group
                 if n in run:
                     continue
                 run.add(n)
@@ -139,7 +150,11 @@ class Network:
                 continue
             inputs = [outs[n] for n in lc.input_names()]
             layer_params = self._layer_param_view(name, params)
-            outs[name] = self.layers[name].forward(layer_params, inputs, ctx)
+            layer = self.layers[name]
+            outs[name] = layer.forward(layer_params, inputs, ctx)
+            extra = getattr(layer, "_extra_outs", None)
+            if extra:
+                outs.update(extra)
         new_state = {**ctx.state, **ctx.updated_state}
         return outs, new_state
 

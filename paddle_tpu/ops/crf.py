@@ -1,0 +1,109 @@
+"""Linear-chain CRF: negative log-likelihood and Viterbi decoding.
+
+Reference: paddle/gserver/layers/LinearChainCRF.{h,cpp} (forward/backward
+alpha-beta recursions), CRFLayer.cpp, CRFDecodingLayer.cpp. Parameter
+layout matches the reference: w is [(num_tags + 2), num_tags] where row 0
+holds start scores a, row 1 end scores b, rows 2.. the transition matrix
+w[i,j] = score(tag i -> tag j).
+
+TPU-first: log-domain forward recursion as a `lax.scan` over time with
+masked carry (padding steps carry alpha through), logsumexp in fp32.
+Backward comes from jax.grad of the log-partition — mathematically the
+same marginals LinearChainCRF.cpp computes by explicit beta recursion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import logsumexp
+
+
+def _split(w):
+    a = w[0]  # start [T]
+    b = w[1]  # end [T]
+    trans = w[2:]  # [T, T]
+    return a, b, trans
+
+
+def crf_log_likelihood(emit, labels, seq_lens, w):
+    """emit: [B,T,N] unnormalized per-step tag scores; labels: [B,T] int;
+    seq_lens: [B]. Returns [B] log p(labels | emit) (negative cost)."""
+    return _crf_score(emit, labels, seq_lens, w) - crf_log_norm(
+        emit, seq_lens, w
+    )
+
+
+def _crf_score(emit, labels, seq_lens, w):
+    a, b, trans = _split(w)
+    bsz, t, n = emit.shape
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    mask = (pos < seq_lens[:, None]).astype(emit.dtype)  # [B,T]
+    picked = jnp.take_along_axis(emit, labels[..., None], axis=-1)[..., 0]
+    score = jnp.sum(picked * mask, axis=1)
+    score = score + a[labels[:, 0]]
+    last = jnp.maximum(seq_lens - 1, 0)
+    last_lab = jnp.take_along_axis(labels, last[:, None], axis=1)[:, 0]
+    score = score + b[last_lab]
+    # transitions between consecutive real steps
+    tr = trans[labels[:, :-1], labels[:, 1:]]  # [B,T-1]
+    score = score + jnp.sum(tr * mask[:, 1:], axis=1)
+    return score
+
+
+def crf_log_norm(emit, seq_lens, w):
+    """log Z via masked forward recursion."""
+    a, b, trans = _split(w)
+    bsz, t, n = emit.shape
+    alpha0 = a[None, :] + emit[:, 0]  # [B,N]
+    pos = jnp.arange(1, t, dtype=jnp.int32)
+    mask = (pos[None, :] < seq_lens[:, None]).astype(emit.dtype)  # [B,T-1]
+
+    def step(alpha, inp):
+        e_t, m_t = inp  # [B,N], [B]
+        nxt = logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1
+        ) + e_t
+        alpha = m_t[:, None] * nxt + (1 - m_t[:, None]) * alpha
+        return alpha, None
+
+    xs = (emit[:, 1:].swapaxes(0, 1), mask.swapaxes(0, 1))
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+    return logsumexp(alpha + b[None, :], axis=1)
+
+
+def crf_decode(emit, seq_lens, w):
+    """Viterbi: returns (best_paths [B,T] int32, best_scores [B])."""
+    a, b, trans = _split(w)
+    bsz, t, n = emit.shape
+    delta0 = a[None, :] + emit[:, 0]
+    pos = jnp.arange(1, t, dtype=jnp.int32)
+    mask = pos[None, :] < seq_lens[:, None]  # [B,T-1] bool
+
+    def step(delta, inp):
+        e_t, m_t = inp
+        cand = delta[:, :, None] + trans[None, :, :]  # [B,from,to]
+        best_prev = jnp.argmax(cand, axis=1)  # [B,N]
+        nxt = jnp.max(cand, axis=1) + e_t
+        new_delta = jnp.where(m_t[:, None], nxt, delta)
+        # on padded steps record identity backpointer
+        bp = jnp.where(
+            m_t[:, None], best_prev, jnp.arange(n, dtype=best_prev.dtype)[None, :]
+        )
+        return new_delta, bp
+
+    xs = (emit[:, 1:].swapaxes(0, 1), mask.swapaxes(0, 1))
+    delta, bps = jax.lax.scan(step, delta0, xs)  # bps: [T-1,B,N]
+    final = delta + b[None, :]
+    last_tag = jnp.argmax(final, axis=1).astype(jnp.int32)  # [B]
+    best_score = jnp.max(final, axis=1)
+
+    def back(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        prev = prev.astype(jnp.int32)
+        return prev, prev
+
+    _, path_prefix = jax.lax.scan(back, last_tag, bps, reverse=True)
+    # path_prefix[t] = best tag at step t (t in 0..T-2); append last tag
+    paths = jnp.concatenate([path_prefix, last_tag[None, :]], axis=0)  # [T,B]
+    return paths.swapaxes(0, 1), best_score

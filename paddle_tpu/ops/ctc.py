@@ -1,0 +1,102 @@
+"""CTC loss (Connectionist Temporal Classification).
+
+Reference: paddle/gserver/layers/LinearChainCTC.{h,cpp} (hand-written
+log-domain alpha recursion, logMul/logAdd helpers) and the warp-ctc
+wrapper (WarpCTCLayer.cpp, hl_warpctc_wrap.cc). One implementation here —
+a `lax.scan` over time on the standard extended-label lattice [2L+1] in
+log domain, batched and masked; no external library.
+
+Conventions (matching LinearChainCTC.cpp): `blank` is a configurable
+class index (the reference uses 0 for warpctc and numClasses_-1
+internally; we default to 0 and expose it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _logaddexp(a, b):
+    return jnp.logaddexp(a, b)
+
+
+def ctc_loss(log_probs, input_lens, labels, label_lens, blank=0):
+    """log_probs: [B,T,C] log-softmax outputs; input_lens: [B];
+    labels: [B,L] int32 (padded with anything); label_lens: [B].
+    Returns [B] negative log likelihood."""
+    bsz, t, c = log_probs.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+
+    # extended label sequence: blank, l1, blank, l2, ..., blank
+    ext = jnp.full((bsz, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    ext_valid = jnp.arange(s)[None, :] < (2 * label_lens + 1)[:, None]
+
+    # can skip from s-2 to s: only when ext[s] is a label and != ext[s-2]
+    can_skip = jnp.zeros((bsz, s), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != ext[:, :-2]) & (ext[:, 2:] != blank)
+    )
+
+    emit0 = jnp.take_along_axis(log_probs[:, 0], ext, axis=1)  # [B,S]
+    alpha0 = jnp.full((bsz, s), NEG_INF)
+    alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+    has_label = label_lens > 0
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(has_label, emit0[:, 1], NEG_INF)
+    )
+
+    pos = jnp.arange(1, t, dtype=jnp.int32)
+    step_mask = (pos[None, :] < input_lens[:, None])  # [B,T-1]
+
+    def step(alpha, inp):
+        lp_t, m_t = inp  # [B,C], [B]
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)  # [B,S]
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((bsz, 1), NEG_INF), alpha[:, :-1]], axis=1
+        )
+        prev2 = jnp.concatenate(
+            [jnp.full((bsz, 2), NEG_INF), alpha[:, :-2]], axis=1
+        )
+        prev2 = jnp.where(can_skip, prev2, NEG_INF)
+        new = _logaddexp(_logaddexp(stay, prev1), prev2) + emit
+        new = jnp.where(ext_valid, new, NEG_INF)
+        return jnp.where(m_t[:, None], new, alpha), None
+
+    xs = (log_probs[:, 1:].swapaxes(0, 1), step_mask.swapaxes(0, 1))
+    alpha, _ = jax.lax.scan(step, alpha0, xs)
+
+    # final: sum of last blank and last label positions
+    end_idx = 2 * label_lens  # last blank
+    a_end = jnp.take_along_axis(alpha, end_idx[:, None], axis=1)[:, 0]
+    lab_idx = jnp.maximum(2 * label_lens - 1, 0)
+    a_lab = jnp.take_along_axis(alpha, lab_idx[:, None], axis=1)[:, 0]
+    a_lab = jnp.where(has_label, a_lab, NEG_INF)
+    ll = _logaddexp(a_end, a_lab)
+    return -ll
+
+
+def ctc_greedy_decode(log_probs, input_lens, blank=0):
+    """Best-path decode: argmax per step, collapse repeats, drop blanks.
+    Returns (paths [B,T] int32 padded with blank, lens [B])."""
+    pred = jnp.argmax(log_probs, axis=-1).astype(jnp.int32)  # [B,T]
+    bsz, t = pred.shape
+    pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    valid = pos < input_lens[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((bsz, 1), -1, jnp.int32), pred[:, :-1]], axis=1
+    )
+    keep = valid & (pred != blank) & (pred != prev)
+
+    # compact kept tokens to the left (stable) via sort on (not keep, pos)
+    order = jnp.argsort(jnp.where(keep, pos, t + pos), axis=1)
+    gathered = jnp.take_along_axis(pred, order, axis=1)
+    lens = jnp.sum(keep, axis=1).astype(jnp.int32)
+    out_pos = jnp.arange(t, dtype=jnp.int32)[None, :]
+    out = jnp.where(out_pos < lens[:, None], gathered, blank)
+    return out, lens

@@ -1,0 +1,206 @@
+"""Recurrent-group executor tests.
+
+Config-equivalence (reference: gserver/tests/test_NetworkCompare.cpp and
+test_RecurrentGradientMachine): a recurrent_group spelling of an RNN must
+compute exactly what the fused `recurrent` layer computes, values and
+gradients, forward and reversed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import dsl
+from paddle_tpu.core.arg import seq
+from paddle_tpu.network import Network
+
+
+def _nets(reversed_=False):
+    h = 5
+    with dsl.model() as ga:
+        x = dsl.data("x", (h,), is_seq=True)
+        dsl.recurrent(x, size=h, name="rnn", act="tanh", bias=False,
+                      reversed=reversed_)
+    net_a = Network(ga.conf)
+
+    with dsl.model() as gb:
+        x = dsl.data("x", (h,), is_seq=True)
+
+        def step(x_t):
+            prev = dsl.memory("h", size=h)
+            return dsl.mixed(
+                h,
+                [(x_t, "identity"), (prev, "full_matrix")],
+                act="tanh", bias=False, name="h",
+            )
+
+        dsl.recurrent_group(step, [x], name="rg", reversed=reversed_)
+    net_b = Network(gb.conf)
+    return net_a, net_b, h
+
+
+def _match_params(net_a, net_b, key):
+    pa = net_a.init_params(key)
+    (wa,) = [v for k, v in pa.items()]
+    pb = {k: jnp.asarray(wa) for k in net_b.param_confs}
+    assert len(pb) == 1
+    return pa, pb
+
+
+def test_group_matches_fused_rnn():
+    for reversed_ in (False, True):
+        net_a, net_b, h = _nets(reversed_)
+        pa, pb = _match_params(net_a, net_b, jax.random.key(0))
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, h)).astype(np.float32)
+        lens = np.asarray([6, 4, 1], np.int32)
+        feed = {"x": seq(x, lens)}
+        ya, _ = net_a.forward(pa, feed)
+        yb, _ = net_b.forward(pb, feed)
+        np.testing.assert_allclose(
+            np.asarray(ya["rnn"].value), np.asarray(yb["rg"].value),
+            rtol=1e-5, atol=1e-6,
+        )
+
+        # gradient equivalence wrt input
+        def loss_a(x_):
+            outs, _ = net_a.forward(pa, {"x": seq(x_, lens)})
+            return jnp.sum(outs["rnn"].value ** 2)
+
+        def loss_b(x_):
+            outs, _ = net_b.forward(pb, {"x": seq(x_, lens)})
+            return jnp.sum(outs["rg"].value ** 2)
+
+        ga = jax.grad(loss_a)(jnp.asarray(x))
+        gb = jax.grad(loss_b)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_group_with_boot_and_static():
+    """Memory boot from a parent layer + static input visible at each
+    step (the StaticInput/boot_layer features of the reference)."""
+    h = 4
+    with dsl.model() as g:
+        x = dsl.data("x", (h,), is_seq=True)
+        init = dsl.data("init", (h,))
+        ctx_v = dsl.data("ctxv", (h,))
+
+        def step(x_t, c):
+            prev = dsl.memory("s", size=h, boot_layer=init)
+            return dsl.mixed(
+                h,
+                [(x_t, "identity"), (prev, "full_matrix"), (c, "identity")],
+                act="tanh", bias=False, name="s",
+            )
+
+        dsl.recurrent_group(step, [x, dsl.StaticInput(ctx_v)], name="rg")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(1))
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, 5, h)).astype(np.float32)
+    lens = np.asarray([5, 3], np.int32)
+    init_v = rng.standard_normal((2, h)).astype(np.float32)
+    ctx_v = rng.standard_normal((2, h)).astype(np.float32)
+    from paddle_tpu.core.arg import non_seq
+
+    outs, _ = net.forward(
+        params,
+        {"x": seq(x, lens), "init": non_seq(init_v), "ctxv": non_seq(ctx_v)},
+    )
+    y = np.asarray(outs["rg"].value)
+    assert y.shape == (2, 5, h)
+
+    # hand-compute step 0 for example 0: s1 = tanh(x0 + init@W + ctx)
+    (w,) = [np.asarray(v) for k, v in params.items()]
+    want0 = np.tanh(x[0, 0] + init_v[0] @ w + ctx_v[0])
+    np.testing.assert_allclose(y[0, 0], want0, rtol=1e-5)
+    # padding region is zeros
+    assert np.all(y[1, 3:] == 0.0)
+
+
+def test_group_seq2seq_style_attention():
+    """Decoder with additive attention over a static encoder sequence —
+    the simple_attention pattern (networks.py:1298) inside a group."""
+    h, dv = 4, 3
+    with dsl.model() as g:
+        enc = dsl.data("enc", (h,), is_seq=True)
+        trg = dsl.data("trg", (dv,), is_seq=True)
+
+        def step(y_t, enc_s):
+            prev = dsl.memory("s", size=h)
+            # attention scores over encoder steps: score = v . tanh(We e + Ws s)
+            proj_s = dsl.fc(prev, size=h, bias=False, name="att_s")
+            expanded = dsl.expand(proj_s, enc_s, name="att_exp")
+            mix = dsl.addto(enc_s, expanded, act="tanh", name="att_mix")
+            scores = dsl.fc(mix, size=1, bias=False, name="att_score",
+                            act="sequence_softmax")
+            scaled = dsl.scaling(scores, enc_s, name="att_scaled")
+            ctx_vec = dsl.seq_pool(scaled, pool_type="sum", name="att_ctx")
+            return dsl.mixed(
+                h,
+                [(y_t, "full_matrix"), (prev, "full_matrix"),
+                 (ctx_vec, "full_matrix")],
+                act="tanh", bias=False, name="s",
+            )
+
+        dsl.recurrent_group(step, [trg, dsl.StaticInput(enc)], name="dec")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(2))
+    rng = np.random.default_rng(2)
+    enc_v = rng.standard_normal((2, 6, h)).astype(np.float32)
+    enc_l = np.asarray([6, 2], np.int32)
+    trg_v = rng.standard_normal((2, 4, dv)).astype(np.float32)
+    trg_l = np.asarray([4, 3], np.int32)
+    outs, _ = net.forward(
+        params, {"enc": seq(enc_v, enc_l), "trg": seq(trg_v, trg_l)}
+    )
+    y = np.asarray(outs["dec"].value)
+    assert y.shape == (2, 4, h)
+    assert np.isfinite(y).all()
+    # grads flow to all params
+    def loss(p):
+        o, _ = net.forward(
+            p, {"enc": seq(enc_v, enc_l), "trg": seq(trg_v, trg_l)}
+        )
+        return jnp.sum(o["dec"].value ** 2)
+
+    grads = jax.grad(loss)(params)
+    for k, gv in grads.items():
+        assert float(jnp.abs(gv).sum()) > 0, f"no grad for {k}"
+
+
+def test_group_multi_output_and_name_isolation():
+    """Tuple-returning step exposes secondary out_links; auto-named step
+    layers must NOT share params with same-shaped auto-named parent
+    layers."""
+    h = 4
+    with dsl.model() as g:
+        x = dsl.data("x", (h,), is_seq=True)
+        # auto-named parent fc, same shape as the step's auto-named fc
+        pre = dsl.fc(x, size=h, bias=False)
+
+        def step(x_t):
+            prev = dsl.memory("s", size=h)
+            s = dsl.mixed(h, [(x_t, "identity"), (prev, "full_matrix")],
+                          act="tanh", bias=False, name="s")
+            gate = dsl.fc(s, size=h, act="sigmoid", bias=False)  # auto name
+            return s, gate
+
+        main, gate_seq = dsl.recurrent_group(step, [pre], name="rg")
+        post = dsl.fc(gate_seq, size=2, name="post", bias=False)
+    net = Network(g.conf)
+    # parent auto fc and step auto fc both exist and are distinct params
+    names = sorted(net.param_confs)
+    assert any(n.startswith("_rg.") for n in names), names
+    fc_params = [n for n in names if "fc_" in n]
+    assert len(fc_params) == 2 and fc_params[0] != fc_params[1], names
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 5, h)).astype(np.float32)
+    lens = np.asarray([5, 3], np.int32)
+    outs, _ = net.forward(params, {"x": seq(x, lens)})
+    assert outs["post"].value.shape == (2, 5, 2)
+    # extra output accessible and pruning works through it
+    outs2, _ = net.forward(params, {"x": seq(x, lens)},
+                           outputs=[gate_seq.name])
+    assert outs2[gate_seq.name].value.shape == (2, 5, h)

@@ -1,0 +1,222 @@
+"""CRF / CTC correctness vs brute-force enumeration (the reference
+cross-checks LinearChainCTC vs warp-ctc in test_WarpCTCLayer.cpp; here we
+cross-check the scan implementations against exhaustive enumeration on
+tiny problems) and NCE/hsigmoid training sanity."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import ctc as ctc_ops
+
+
+def brute_crf_log_norm(emit, length, w):
+    """Enumerate all paths for one example."""
+    a, b, trans = w[0], w[1], w[2:]
+    n = emit.shape[-1]
+    scores = []
+    for path in itertools.product(range(n), repeat=length):
+        s = a[path[0]] + emit[0, path[0]] + b[path[-1]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+        scores.append(s)
+    return np.logaddexp.reduce(scores)
+
+
+def test_crf_log_norm_vs_brute_force():
+    rng = np.random.default_rng(0)
+    n, tmax = 3, 5
+    emit = rng.standard_normal((2, tmax, n)).astype(np.float32)
+    w = rng.standard_normal((n + 2, n)).astype(np.float32)
+    lens = np.asarray([5, 3], np.int32)
+    got = np.asarray(crf_ops.crf_log_norm(jnp.asarray(emit),
+                                          jnp.asarray(lens), jnp.asarray(w)))
+    for i in range(2):
+        want = brute_crf_log_norm(emit[i], int(lens[i]), w)
+        np.testing.assert_allclose(got[i], want, rtol=1e-4)
+
+
+def test_crf_loglik_is_normalized():
+    """sum over all label sequences of exp(loglik) == 1."""
+    rng = np.random.default_rng(1)
+    n, t = 3, 4
+    emit = jnp.asarray(rng.standard_normal((1, t, n)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((n + 2, n)), jnp.float32)
+    lens = jnp.asarray([t], jnp.int32)
+    total = 0.0
+    for path in itertools.product(range(n), repeat=t):
+        lab = jnp.asarray([list(path)], jnp.int32)
+        ll = crf_ops.crf_log_likelihood(emit, lab, lens, w)
+        total += float(jnp.exp(ll[0]))
+    assert abs(total - 1.0) < 1e-4
+
+
+def test_crf_decode_matches_brute_force():
+    rng = np.random.default_rng(2)
+    n, t = 3, 4
+    emit = rng.standard_normal((2, t, n)).astype(np.float32)
+    w = rng.standard_normal((n + 2, n)).astype(np.float32)
+    lens = np.asarray([4, 2], np.int32)
+    paths, scores = crf_ops.crf_decode(
+        jnp.asarray(emit), jnp.asarray(lens), jnp.asarray(w)
+    )
+    paths = np.asarray(paths)
+    a, b, trans = w[0], w[1], w[2:]
+    for i in range(2):
+        best, best_s = None, -1e30
+        for path in itertools.product(range(n), repeat=int(lens[i])):
+            s = a[path[0]] + emit[i, 0, path[0]] + b[path[-1]]
+            for tt in range(1, int(lens[i])):
+                s += trans[path[tt - 1], path[tt]] + emit[i, tt, path[tt]]
+            if s > best_s:
+                best, best_s = path, s
+        assert tuple(paths[i, : int(lens[i])]) == best
+        np.testing.assert_allclose(float(scores[i]), best_s, rtol=1e-4)
+
+
+def brute_ctc_nll(log_probs, t_len, labels, blank):
+    """Enumerate all alignments of length t_len that collapse to labels."""
+    c = log_probs.shape[-1]
+    total = None
+    for path in itertools.product(range(c), repeat=t_len):
+        # collapse
+        out = []
+        prev = -1
+        for p in path:
+            if p != blank and p != prev:
+                out.append(p)
+            prev = p
+        if out == list(labels):
+            s = sum(log_probs[t, p] for t, p in enumerate(path))
+            total = s if total is None else np.logaddexp(total, s)
+    return -total
+
+
+def test_ctc_vs_brute_force():
+    rng = np.random.default_rng(3)
+    c, t = 3, 4
+    logits = rng.standard_normal((2, t, c)).astype(np.float32)
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    labels = np.asarray([[1, 2], [2, 0]], np.int32)
+    label_lens = np.asarray([2, 1], np.int32)
+    input_lens = np.asarray([4, 3], np.int32)
+    got = np.asarray(
+        ctc_ops.ctc_loss(
+            jnp.asarray(lp), jnp.asarray(input_lens), jnp.asarray(labels),
+            jnp.asarray(label_lens), blank=0,
+        )
+    )
+    for i in range(2):
+        want = brute_ctc_nll(
+            lp[i], int(input_lens[i]),
+            labels[i, : int(label_lens[i])].tolist(), 0,
+        )
+        np.testing.assert_allclose(got[i], want, rtol=1e-4)
+
+
+def test_ctc_greedy_decode():
+    # [blank, a, a, blank, b] -> [a, b]
+    lp = np.full((1, 5, 3), -10.0, np.float32)
+    for t, cls in enumerate([0, 1, 1, 0, 2]):
+        lp[0, t, cls] = 0.0
+    out, lens = ctc_ops.ctc_greedy_decode(
+        jnp.asarray(lp), jnp.asarray([5], np.int32), blank=0
+    )
+    assert int(lens[0]) == 2
+    assert out[0, :2].tolist() == [1, 2]
+
+
+def test_crf_layer_trains():
+    from paddle_tpu import dsl
+    from paddle_tpu.core.arg import id_arg, seq
+    from paddle_tpu.core.config import InputConf, LayerConf, ModelConf, OptimizationConf
+    from paddle_tpu.network import Network
+    from paddle_tpu.optimizers import create_optimizer
+    from paddle_tpu.testing import data_conf
+
+    n_tags = 4
+    conf = ModelConf(layers=[
+        data_conf("x", 6, is_seq=True),
+        data_conf("lbl", 1, is_seq=True, is_ids=True),
+        LayerConf(name="emit", type="fc", size=n_tags, inputs=[InputConf("x")]),
+        LayerConf(name="crf", type="crf", size=n_tags,
+                  inputs=[InputConf("emit"), InputConf("lbl")], bias=False),
+    ])
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(
+        OptimizationConf(learning_method="adam", learning_rate=0.05),
+        net.param_confs,
+    )
+    ost = opt.init_state(params)
+    rng = np.random.default_rng(0)
+    # learnable rule: tag = feature argmax bucket
+    xs = rng.standard_normal((32, 7, 6)).astype(np.float32)
+    ys = (np.argmax(xs[..., :4], axis=-1)).astype(np.int32)
+    lens = rng.integers(3, 8, 32).astype(np.int32)
+
+    @jax.jit
+    def step(params, ost, i):
+        feed = {"x": seq(xs, lens), "lbl": id_arg(ys, lens)}
+        (loss, _), g = jax.value_and_grad(net.loss_fn, has_aux=True)(params, feed)
+        params, ost = opt.update(g, params, ost, i)
+        return params, ost, loss
+
+    first = last = None
+    for i in range(60):
+        params, ost, loss = step(params, ost, i)
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < 0.35 * first, (first, last)
+
+
+def test_nce_and_hsigmoid_train():
+    from paddle_tpu.core.arg import id_arg, non_seq
+    from paddle_tpu.core.config import InputConf, LayerConf, ModelConf, OptimizationConf
+    from paddle_tpu.network import Network
+    from paddle_tpu.optimizers import create_optimizer
+    from paddle_tpu.testing import data_conf
+
+    rng = np.random.default_rng(1)
+    d, nc = 8, 16
+    w_true = rng.standard_normal((d, nc))
+    xs = rng.standard_normal((64, d)).astype(np.float32)
+    ys = np.argmax(xs @ w_true, axis=1).astype(np.int32)
+
+    for cost_type, attrs in [
+        ("nce", {"num_classes": nc, "num_neg_samples": 8}),
+        ("hsigmoid", {"num_classes": nc}),
+    ]:
+        conf = ModelConf(layers=[
+            data_conf("x", d),
+            data_conf("y", 1, is_ids=True),
+            LayerConf(name="cost", type=cost_type,
+                      inputs=[InputConf("x"), InputConf("y")], attrs=attrs),
+        ])
+        net = Network(conf)
+        params = net.init_params(jax.random.key(2))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="adam", learning_rate=0.05),
+            net.param_confs,
+        )
+        ost = opt.init_state(params)
+
+        @jax.jit
+        def step(params, ost, i, _net=net, _opt=opt):
+            feed = {"x": non_seq(xs), "y": id_arg(ys)}
+            (loss, _), g = jax.value_and_grad(_net.loss_fn, has_aux=True)(
+                params, feed, rng=jax.random.key(i)
+            )
+            params, ost = _opt.update(g, params, ost, i)
+            return params, ost, loss
+
+        first = last = None
+        for i in range(50):
+            params, ost, loss = step(params, ost, i)
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < 0.7 * first, (cost_type, first, last)
