@@ -25,6 +25,8 @@ _DEFAULTS: dict[str, Any] = {
     "start_pass": 0,
     # data
     "prefetch_depth": 2,
+    # kernels: None = auto (fused Pallas cells on TPU, lax.scan elsewhere)
+    "use_pallas_rnn": None,
     # precision policy: params in float32, matmuls in bfloat16 by default
     "default_dtype": "float32",
     "matmul_precision": "default",

@@ -41,10 +41,23 @@ def make_mesh(shape: Optional[dict] = None, devices=None) -> Mesh:
     sizes = list(shape.values())
     if -1 in sizes:
         known = math.prod(s for s in sizes if s != -1)
+        if n % known != 0:
+            raise ValueError(
+                f"mesh shape {dict(zip(names, sizes))}: -1 cannot take the "
+                f"remaining devices ({known} does not divide {n})"
+            )
         sizes[sizes.index(-1)] = n // known
-    if math.prod(sizes) != n:
-        raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
-    dev_array = np.asarray(devices).reshape(sizes)
+    prod = math.prod(sizes)
+    if prod > n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} > {n} devices")
+    if prod < n:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "mesh shape %s uses %d of %d devices (prefix sub-mesh)",
+            dict(zip(names, sizes)), prod, n,
+        )
+    dev_array = np.asarray(devices[:prod]).reshape(sizes)
     return Mesh(dev_array, axis_names=tuple(names))
 
 

@@ -1,6 +1,7 @@
 """Layer library. Importing this package registers all layer types."""
 
 from paddle_tpu.layers import (  # noqa: F401
+    attention,
     base,
     basic,
     conv,

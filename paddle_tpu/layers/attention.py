@@ -1,0 +1,90 @@
+"""Multi-head attention layer with pluggable sequence parallelism.
+
+Long-context capability layer (beyond the reference's 2017 additive
+attention built from mixed/expand layers in
+trainer_config_helpers/networks.py:1298 simple_attention — which is also
+reproduced, via models/text.py). attrs:
+  num_heads     — head count (must divide size)
+  causal        — bool, autoregressive mask
+  seq_parallel  — "none" (dense, GSPMD-friendly) | "ring" | "ulysses";
+                  ring/ulysses shard the time dim over the mesh `seq`
+                  axis (parallel/ring.py) and need the global mesh set via
+                  paddle_tpu.core.mesh.set_mesh.
+Inputs: one sequence Arg (self-attention) or (query, keyvalue).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Ctx, Layer, Spec
+
+
+@LAYERS.register("multi_head_attention", "attention")
+class MultiHeadAttentionLayer(Layer):
+    def build(self, in_specs):
+        d = self.conf.size
+        h = self.conf.attrs.get("num_heads", 1)
+        assert d % h == 0, f"size {d} not divisible by num_heads {h}"
+        sq = in_specs[0]
+        skv = in_specs[-1]
+        assert sq.is_seq and skv.is_seq, "attention needs sequence inputs"
+        # distinct names per projection — weight_conf(idx) keys on the
+        # input edge, which would alias all four for self-attention
+        pcs = {}
+        for slot, idx, dims in (
+            ("wq", 0, (sq.size, d)),
+            ("wk", len(in_specs) - 1, (skv.size, d)),
+            ("wv", len(in_specs) - 1, (skv.size, d)),
+            ("wo", 0, (d, d)),
+        ):
+            pc = self.weight_conf(idx, dims)
+            pc.name = f"_{self.name}.{slot}"
+            pcs[slot] = pc
+        b = self.bias_conf((d,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(d,), is_seq=True), pcs
+
+    def forward(self, params, inputs, ctx: Ctx):
+        qa = inputs[0]
+        kva = inputs[-1]
+        h = self.conf.attrs.get("num_heads", 1)
+        causal = bool(self.conf.attrs.get("causal", False))
+        mode = self.conf.attrs.get("seq_parallel", "none")
+        d = self.conf.size
+        hd = d // h
+
+        def split_heads(x):
+            return x.reshape(x.shape[0], x.shape[1], h, hd)
+
+        q = split_heads(jnp.dot(qa.value, params["wq"]))
+        k = split_heads(jnp.dot(kva.value, params["wk"]))
+        v = split_heads(jnp.dot(kva.value, params["wv"]))
+
+        from paddle_tpu.parallel import ring
+
+        if mode == "none":
+            out = ring.dense_attention(
+                q, k, v, causal=causal, kv_len=kva.seq_lens
+            )
+        else:
+            from paddle_tpu.core.mesh import get_mesh
+
+            fn = ring.ring_attention if mode == "ring" else ring.ulysses_attention
+            out = fn(
+                q, k, v, get_mesh(), causal=causal, kv_lens=kva.seq_lens
+            )
+        out = out.reshape(out.shape[0], out.shape[1], d)
+        y = jnp.dot(out, params["wo"])
+        if "b" in params:
+            y = y + params["b"]
+        y = self.apply_activation_and_dropout(y, ctx, qa.seq_lens)
+        # zero padded query positions so downstream seq reductions stay exact
+        if qa.seq_lens is not None:
+            t = y.shape[1]
+            pos = jnp.arange(t)[None, :]
+            y = jnp.where((pos < qa.seq_lens[:, None])[..., None], y, 0.0)
+        return Arg(value=y, seq_lens=qa.seq_lens)

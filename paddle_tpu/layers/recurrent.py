@@ -32,6 +32,21 @@ from paddle_tpu.ops import activations
 from paddle_tpu.ops import sequence_ops as sops
 
 
+def _use_fused() -> bool:
+    """Fused Pallas cell policy: flag override, else auto (TPU only)."""
+    from paddle_tpu.core.flags import get_flag
+    from paddle_tpu.ops import pallas_rnn
+
+    v = get_flag("use_pallas_rnn")
+    if v is None:
+        return pallas_rnn.use_fused_default()
+    return bool(v)
+
+
+def _interpret_mode() -> bool:
+    return jax.devices()[0].platform == "cpu"
+
+
 def _scan_rnn(step, x_btd, seq_lens, init_carry, reverse=False):
     """Run `step(carry, x_t, m_t) -> (carry, y_t)` over time with masked
     carry. x_btd: [B,T,D]. Returns y: [B,T,H]."""
@@ -126,6 +141,25 @@ class LstmLayer(Layer):
             gb = jnp.zeros((4 * h,), arg.value.dtype)
             wci = wcf = wco = jnp.zeros((h,), arg.value.dtype)
 
+        default_acts = (
+            (self.conf.active_type or "tanh") == "tanh"
+            and self.conf.attrs.get("active_gate_type", "sigmoid") == "sigmoid"
+            and self.conf.attrs.get("active_state_type", "tanh") == "tanh"
+        )
+        if default_acts and _use_fused():
+            from paddle_tpu.ops import pallas_rnn
+
+            x = arg.value
+            rev = self.conf.attrs.get("reversed", False)
+            if rev:
+                x = sops.reverse_seq(x, arg.seq_lens)
+            y = pallas_rnn.lstm_fused(
+                x, w, gb, wci, wcf, wco, arg.seq_lens, _interpret_mode()
+            )
+            if rev:
+                y = sops.reverse_seq(y, arg.seq_lens)
+            return Arg(value=y, seq_lens=arg.seq_lens)
+
         def step(carry, x_t):
             h_prev, c_prev = carry
             g = x_t + jnp.dot(h_prev, w) + gb
@@ -175,6 +209,24 @@ class GruLayer(Layer):
         w_g = params["w0"]  # [h, 2h] for update+reset
         w_c = params["w_c"]  # [h, h] candidate
         b = params.get("b", jnp.zeros((3 * h,), arg.value.dtype))
+
+        default_acts = (
+            (self.conf.active_type or "tanh") == "tanh"
+            and self.conf.attrs.get("active_gate_type", "sigmoid") == "sigmoid"
+        )
+        if default_acts and _use_fused():
+            from paddle_tpu.ops import pallas_rnn
+
+            x = arg.value
+            rev = self.conf.attrs.get("reversed", False)
+            if rev:
+                x = sops.reverse_seq(x, arg.seq_lens)
+            y = pallas_rnn.gru_fused(
+                x, w_g, w_c, b, arg.seq_lens, _interpret_mode()
+            )
+            if rev:
+                y = sops.reverse_seq(y, arg.seq_lens)
+            return Arg(value=y, seq_lens=arg.seq_lens)
 
         def step(h_prev, x_t):
             xu, xr, xc = jnp.split(x_t + b, 3, axis=-1)

@@ -152,6 +152,18 @@ class Network:
             layer_params = self._layer_param_view(name, params)
             layer = self.layers[name]
             outs[name] = layer.forward(layer_params, inputs, ctx)
+            spec = lc.attrs.get("out_sharding")
+            if spec is not None:
+                # Per-layer placement hint — the GSPMD replacement for the
+                # reference's ParallelNeuralNetwork per-layer `device` attr
+                # (gserver/gradientmachines/ParallelNeuralNetwork.h:34).
+                from jax.sharding import PartitionSpec
+                from paddle_tpu.core.mesh import get_mesh
+                from paddle_tpu.parallel.sharding import constrain
+
+                outs[name] = constrain(
+                    outs[name], get_mesh(), PartitionSpec(*spec)
+                )
             extra = getattr(layer, "_extra_outs", None)
             if extra:
                 outs.update(extra)

@@ -1,0 +1,25 @@
+"""Parallelism over the device mesh: data (dp), tensor/model (sharding),
+sequence/context (ring), sharded embeddings (sparse)."""
+
+from paddle_tpu.parallel.dp import (  # noqa: F401
+    TrainStep,
+    batch_sharding,
+    param_sharding,
+    replicated,
+    shard_batch,
+)
+from paddle_tpu.parallel.ring import (  # noqa: F401
+    dense_attention,
+    ring_attention,
+    ulysses_attention,
+)
+from paddle_tpu.parallel.sharding import (  # noqa: F401
+    Sharder,
+    auto_param_spec,
+    constrain,
+)
+from paddle_tpu.parallel.sparse import (  # noqa: F401
+    apply_rows,
+    embedding_lookup,
+    touched_rows,
+)

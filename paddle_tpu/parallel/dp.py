@@ -35,13 +35,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def param_sharding(mesh: Mesh, pc=None) -> NamedSharding:
-    """Parameter placement. Default: replicated. Large 2-D params can be
-    sharded over `data` on their output dim (ZeRO-ish) via
-    pc.attrs in future rounds; embeddings with sparse_remote_update are
-    sharded over rows (the pserver-sharded-table analogue)."""
-    if pc is not None and getattr(pc, "sparse_remote_update", False):
-        return NamedSharding(mesh, P(DATA_AXIS))
-    return NamedSharding(mesh, P())
+    """Parameter placement: delegated to the tensor-parallel auto rules
+    (parallel/sharding.py) — replicated on a pure-data mesh, model-sharded
+    weights / row-sharded embedding tables when a `model` axis exists."""
+    from paddle_tpu.parallel.sharding import auto_param_spec
+
+    if pc is None:
+        return NamedSharding(mesh, P())
+    return NamedSharding(mesh, auto_param_spec(pc, mesh))
 
 
 def shard_batch(feed: dict, mesh: Mesh) -> dict:
@@ -69,10 +70,12 @@ class TrainStep:
         mesh: Optional[Mesh] = None,
         donate=True,
         keep_outputs=None,
+        sharding_rules=None,
     ):
         self.net = net
         self.opt = opt
         self.mesh = mesh
+        self.sharding_rules = sharding_rules
         # Only declared outputs survive the step: returning every layer's
         # activations would pin all intermediates in HBM and block XLA
         # fusion/rematerialization.
@@ -91,12 +94,12 @@ class TrainStep:
             return new_params, new_opt_state, new_state, loss, outs
 
         if mesh is not None:
+            from paddle_tpu.parallel.sharding import Sharder
+
             rep = replicated(mesh)
             data = batch_sharding(mesh)
-            param_sh = {
-                name: param_sharding(mesh, pc)
-                for name, pc in net.param_confs.items()
-            }
+            sharder = Sharder(mesh, rules=sharding_rules)
+            param_sh = sharder.param_shardings(net.param_confs)
 
             def param_tree_sharding(params):
                 return {k: param_sh.get(k, rep) for k in params}

@@ -1,0 +1,195 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+New first-class capability (absent in the 2017 reference — SURVEY.md §2
+"Pipeline / TP / SP" row): long sequences are sharded over the mesh `seq`
+axis. Two strategies:
+
+- ``ring_attention``: K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each device keeps its Q shard; softmax is
+  accumulated online (flash-attention style running max/denominator), so
+  the full [T, T] score matrix never materializes and comm rides ICI
+  neighbor links.
+- ``ulysses_attention``: ``lax.all_to_all`` reshards seq -> heads, runs
+  dense local attention per head group, and reshards back.
+
+Both are numerically identical to dense masked attention (tested on a
+virtual CPU mesh in tests/test_parallel_tp_sp.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import SEQ_AXIS
+
+NEG_INF = -1e30
+
+
+def dense_attention(q, k, v, *, causal=False, kv_len=None, scale=None):
+    """Reference masked attention. q,k,v: [B, T, H, D]; kv_len: [B] valid
+    K/V length (padding masked out)."""
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(D).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.zeros((B, 1, Tq, Tk), q.dtype)
+    if kv_len is not None:
+        pad = jnp.arange(Tk)[None, :] >= kv_len[:, None]  # [B, Tk]
+        mask = jnp.where(pad[:, None, None, :], NEG_INF, mask)
+    if causal:
+        qpos = jnp.arange(Tq)[:, None]
+        kpos = jnp.arange(Tk)[None, :]
+        mask = mask + jnp.where(kpos > qpos, NEG_INF, 0.0)
+    p = jax.nn.softmax(s + mask, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_body(axis_name, n_shards, causal, scale, q, k0, v0, q_off, kv_lens):
+    """Online-softmax accumulation over ring steps. Shapes per shard:
+    q: [B, Tq, H, D]; k0/v0: [B, Tk, H, D] (local shard); q_off scalar
+    global offset of this shard's queries; kv_lens: [B] global valid len."""
+    B, Tq, H, D = q.shape
+    Tk = k0.shape[1]
+    my = lax.axis_index(axis_name)
+
+    acc = jnp.zeros((B, Tq, H, D), jnp.float32)
+    m = jnp.full((B, H, Tq), NEG_INF, jnp.float32)
+    den = jnp.zeros((B, H, Tq), jnp.float32)
+
+    qpos = q_off + jnp.arange(Tq)
+
+    def step(i, carry):
+        acc, m, den, k, v = carry
+        src = (my - i) % n_shards  # whose K/V block we hold at step i
+        k_off = src * Tk
+        kpos = k_off + jnp.arange(Tk)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) * scale
+        neg = jnp.zeros((B, 1, Tq, Tk), jnp.float32)
+        if kv_lens is not None:
+            pad = kpos[None, :] >= kv_lens[:, None]
+            neg = jnp.where(pad[:, None, None, :], NEG_INF, neg)
+        if causal:
+            neg = neg + jnp.where(
+                kpos[None, :] > qpos[:, None], NEG_INF, 0.0
+            )[None, None]
+        s = s + neg
+        blk_max = jnp.max(s, axis=-1)  # [B,H,Tq]
+        m_new = jnp.maximum(m, blk_max)
+        # guard: all-masked block keeps m at NEG_INF; exp underflows to 0
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        den_new = den * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        acc_new = acc * corr.transpose(0, 2, 1)[..., None] + pv
+
+        def rotate(kv):
+            return jax.tree_util.tree_map(
+                lambda x: lax.ppermute(
+                    x,
+                    axis_name,
+                    [(j, (j + 1) % n_shards) for j in range(n_shards)],
+                ),
+                kv,
+            )
+
+        # the last step's rotation would be discarded — skip the exchange
+        k, v = lax.cond(
+            i < n_shards - 1, rotate, lambda kv: kv, (k, v)
+        )
+        return acc_new, m_new, den_new, k, v
+
+    acc, m, den, _, _ = lax.fori_loop(
+        0, n_shards, step, (acc, m, den, k0, v0)
+    )
+    den = jnp.where(den == 0.0, 1.0, den)  # fully-masked query rows
+    out = acc / den.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def _batch_axis(mesh: Mesh):
+    from paddle_tpu.core.mesh import DATA_AXIS
+
+    return DATA_AXIS if DATA_AXIS in mesh.axis_names else None
+
+
+def ring_attention(
+    q, k, v, mesh: Mesh, *, axis: str = SEQ_AXIS, causal=False, kv_lens=None
+):
+    """q,k,v: [B, T, H, D] with T sharded over `axis` (and B over `data`
+    when that axis exists). kv_lens: [B] valid lengths (global). Returns
+    [B, T, H, D] sharded the same way."""
+    n = mesh.shape[axis]
+    D = q.shape[-1]
+    scale = 1.0 / (D**0.5)
+    Tq_local = q.shape[1] // n
+    b = _batch_axis(mesh)
+    spec = P(b, axis, None, None)
+
+    def local(q, k, v, kv_lens):
+        idx = lax.axis_index(axis)
+        return _ring_body(
+            axis, n, causal, scale, q, k, v, idx * Tq_local, kv_lens
+        )
+
+    if kv_lens is None:
+        return jax.shard_map(
+            lambda a, c, d: local(a, c, d, None),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(b)),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v, kv_lens)
+
+
+def ulysses_attention(
+    q, k, v, mesh: Mesh, *, axis: str = SEQ_AXIS, causal=False, kv_lens=None
+):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): reshard
+    [B, T/s, H, D] -> [B, T, H/s, D], dense attention locally, reshard
+    back. Heads must divide the axis size."""
+    n = mesh.shape[axis]
+    H = q.shape[2]
+    assert H % n == 0, f"heads {H} not divisible by seq shards {n}"
+
+    def local(q, k, v, kv_lens):
+        # local shapes: q [B, T/s, H, D] -> all_to_all over heads
+        qh, kh, vh = (
+            lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+            for x in (q, k, v)
+        )  # [B, T, H/s, D]
+        out = dense_attention(qh, kh, vh, causal=causal, kv_len=kv_lens)
+        return lax.all_to_all(
+            out, axis, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    b = _batch_axis(mesh)
+    spec = P(b, axis, None, None)
+    if kv_lens is None:
+        return jax.shard_map(
+            lambda x, y, z: local(x, y, z, None),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )(q, k, v)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P(b)),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v, kv_lens)

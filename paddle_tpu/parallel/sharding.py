@@ -1,0 +1,99 @@
+"""Sharding rules: parameters and activations onto the device mesh.
+
+The reference's model parallelism was (a) per-layer `device` placement in
+ParallelNeuralNetwork (gserver/gradientmachines/ParallelNeuralNetwork.h:34,
+61,63) and (b) pserver-sharded embedding tables pulled row-wise
+(math/SparseRowMatrix.h:204, doc/design/cluster_train/
+large_model_dist_train.md). TPU-first both become GSPMD sharding
+annotations: parameters get a PartitionSpec over the mesh `model` axis and
+XLA inserts the collectives; per-layer placement hints become
+`with_sharding_constraint` on layer outputs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def _axis_size(mesh: Mesh, axis: str) -> int:
+    try:
+        return mesh.shape[axis]
+    except KeyError:
+        return 1
+
+
+def auto_param_spec(pc, mesh: Mesh) -> P:
+    """Default tensor-parallel placement for one parameter.
+
+    - row-sharded embedding tables (sparse_remote_update — the pserver
+      sharded-table analogue): rows over `model` (or `data` if no model
+      axis, matching ZeRO-style placement);
+    - 2-D weights [in, out]: output dim over `model` when divisible
+      (Megatron-style column parallel; XLA's sharding propagation derives
+      the matching row-parallel layouts for consumers);
+    - 1-D biases: over `model` when divisible and a model axis exists.
+    """
+    m = _axis_size(mesh, MODEL_AXIS)
+    dims = tuple(pc.dims)
+    if getattr(pc, "sparse_remote_update", False) and len(dims) == 2:
+        if m > 1 and dims[0] % m == 0:
+            return P(MODEL_AXIS, None)
+        d = _axis_size(mesh, DATA_AXIS)
+        if d > 1 and dims[0] % d == 0:
+            return P(DATA_AXIS, None)
+        return P()
+    if m <= 1:
+        return P()
+    if len(dims) == 2 and dims[1] % m == 0 and dims[1] >= m:
+        return P(None, MODEL_AXIS)
+    if len(dims) == 4 and dims[-1] % m == 0:  # conv kernels HWIO
+        return P(None, None, None, MODEL_AXIS)
+    return P()
+
+
+class Sharder:
+    """Maps parameter names to NamedShardings.
+
+    `rules` is a list of (regex, PartitionSpec) tried in order; unmatched
+    parameters fall back to `auto_param_spec`. The regex tier is the
+    explicit-placement escape hatch (the analogue of the reference's
+    per-layer `device` attribute)."""
+
+    def __init__(self, mesh: Mesh, rules: Optional[list] = None):
+        self.mesh = mesh
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+
+    def spec(self, name: str, pc) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return auto_param_spec(pc, self.mesh)
+
+    def sharding(self, name: str, pc) -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(name, pc))
+
+    def param_shardings(self, param_confs: dict) -> dict:
+        return {n: self.sharding(n, pc) for n, pc in param_confs.items()}
+
+
+def activation_spec(mesh: Mesh, seq_sharded: bool = False) -> P:
+    """Canonical activation layout: batch over `data`, optionally the
+    time dim over `seq` (sequence parallelism)."""
+    if seq_sharded and _axis_size(mesh, SEQ_AXIS) > 1:
+        return P(DATA_AXIS, SEQ_AXIS)
+    return P(DATA_AXIS)
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    """with_sharding_constraint that tolerates rank < len(spec)."""
+    def one(a):
+        s = P(*tuple(spec)[: a.ndim])
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, s))
+
+    return jax.tree_util.tree_map(one, x)

@@ -1,0 +1,72 @@
+"""Sharded embedding tables + row-sparse updates (large-model training).
+
+Capability parity with the reference's sparse distributed training: huge
+embedding tables sharded across pservers, trainers prefetching only the
+rows a batch touches and pushing row-sparse gradients
+(math/SparseRowMatrix.h:29,204,235; trainer/RemoteParameterUpdater.h:265;
+ParameterService.proto:40 GET_PARAMETER_SPARSE;
+doc/design/cluster_train/large_model_dist_train.md).
+
+TPU-first: the table lives row-sharded over the mesh (`model` axis) in
+HBM. Lookup is a shard_map: each shard gathers the rows it owns and a
+psum combines partial rows — one ICI allreduce instead of a pserver RPC.
+The backward of this program is automatically the row-sparse
+scatter-add, and `touched_rows`/`apply_rows` reproduce the
+"optimize only touched rows" update rule (ThreadParameterUpdater.h:71
+catchUpWith semantics) for the host-side updater parity tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import MODEL_AXIS
+
+
+def embedding_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS):
+    """Gather rows from a row-sharded table.
+
+    table: [V, D] sharded P(axis, None); ids: int32 [...] replicated.
+    Returns [..., D] replicated (shard it over data/batch downstream via
+    sharding constraints; XLA folds the transpose)."""
+    n = mesh.shape[axis]
+    V = table.shape[0]
+    assert V % n == 0, f"vocab {V} not divisible by {n} shards"
+    rows_local = V // n
+
+    def local(tbl, ids):
+        shard = lax.axis_index(axis)
+        local_ids = ids - shard * rows_local
+        ok = (local_ids >= 0) & (local_ids < rows_local)
+        safe = jnp.clip(local_ids, 0, rows_local - 1)
+        rows = jnp.take(tbl, safe, axis=0)
+        rows = jnp.where(ok[..., None], rows, 0)
+        return lax.psum(rows, axis)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(table, ids)
+
+
+def touched_rows(ids, vocab_size: int):
+    """Boolean [V] marker of rows referenced by this batch — the analogue
+    of the prefetch row-id set (SparsePrefetchRowCpuMatrix)."""
+    return (
+        jnp.zeros((vocab_size,), jnp.bool_)
+        .at[ids.reshape(-1)]
+        .set(True)
+    )
+
+def apply_rows(update_fn, param, grad, touched):
+    """Apply `update_fn(param_rows, grad_rows) -> new_rows` only to touched
+    rows, leaving the rest bit-identical — the sparse_update optimizer
+    contract (ParameterOptimizer needSpecialTraversal / catchUpWith)."""
+    new = update_fn(param, grad)
+    return jnp.where(touched[:, None], new, param)

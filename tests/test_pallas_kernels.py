@@ -1,0 +1,110 @@
+"""Fused Pallas RNN cells vs the lax.scan reference — the CPU-vs-GPU
+cross-check discipline of the reference's math tests
+(paddle/math/tests/test_matrixCompare.cpp), here scan-vs-kernel."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core.flags import reset_flags, set_flag
+from paddle_tpu.ops import pallas_rnn as pr
+
+
+@pytest.fixture(autouse=True)
+def _flags():
+    yield
+    reset_flags()
+
+
+def _lens(*v):
+    return jnp.array(v, jnp.int32)
+
+
+class TestFusedLstm:
+    def test_forward_matches_scan(self):
+        B, T, h = 4, 6, 8
+        x = jax.random.normal(jax.random.key(0), (B, T, 4 * h))
+        w = jax.random.normal(jax.random.key(1), (h, 4 * h)) * 0.1
+        gb = jnp.linspace(-0.1, 0.1, 4 * h)
+        wci, wcf, wco = (jnp.full((h,), s) for s in (0.05, -0.03, 0.02))
+        lens = _lens(6, 4, 1, 0)
+        ref = pr.lstm_ref(x, w, gb, wci, wcf, wco, lens)
+        out = pr.lstm_fused(x, w, gb, wci, wcf, wco, lens, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+    def test_grad_matches_scan(self):
+        B, T, h = 2, 4, 4
+        x = jax.random.normal(jax.random.key(2), (B, T, 4 * h))
+        w = jax.random.normal(jax.random.key(3), (h, 4 * h)) * 0.2
+        gb = jnp.zeros(4 * h)
+        wci = wcf = wco = jnp.full((h,), 0.1)
+        lens = _lens(4, 2)
+
+        gk = jax.grad(
+            lambda x, w: jnp.sum(
+                pr.lstm_fused(x, w, gb, wci, wcf, wco, lens, True) ** 2
+            ),
+            argnums=(0, 1),
+        )(x, w)
+        gr = jax.grad(
+            lambda x, w: jnp.sum(
+                pr.lstm_ref(x, w, gb, wci, wcf, wco, lens) ** 2
+            ),
+            argnums=(0, 1),
+        )(x, w)
+        for a, b in zip(gk, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+class TestFusedGru:
+    def test_forward_matches_scan(self):
+        B, T, h = 4, 5, 8
+        x = jax.random.normal(jax.random.key(4), (B, T, 3 * h))
+        w_g = jax.random.normal(jax.random.key(5), (h, 2 * h)) * 0.1
+        w_c = jax.random.normal(jax.random.key(6), (h, h)) * 0.1
+        b = jnp.linspace(-0.1, 0.1, 3 * h)
+        lens = _lens(5, 3, 2, 0)
+        ref = pr.gru_ref(x, w_g, w_c, b, lens)
+        out = pr.gru_fused(x, w_g, w_c, b, lens, True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-6)
+
+
+class TestLayerIntegration:
+    @pytest.mark.parametrize("ltype,mult", [("lstmemory", 4), ("grumemory", 3)])
+    @pytest.mark.parametrize("reversed_", [False, True])
+    def test_layer_fused_equals_scan(self, ltype, mult, reversed_):
+        from paddle_tpu.core.arg import seq
+        from paddle_tpu.core.config import InputConf, LayerConf, ModelConf
+        from paddle_tpu.network import Network
+
+        B, T, h = 3, 5, 4
+        conf = ModelConf(
+            layers=[
+                LayerConf(
+                    name="x",
+                    type="data",
+                    attrs={"dim": (mult * h,), "is_seq": True},
+                ),
+                LayerConf(
+                    name="r",
+                    type=ltype,
+                    size=h,
+                    inputs=[InputConf("x")],
+                    attrs={"reversed": reversed_},
+                ),
+            ]
+        )
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        x = seq(
+            jax.random.normal(jax.random.key(1), (B, T, mult * h)),
+            jnp.array([5, 3, 1], jnp.int32),
+        )
+        set_flag("use_pallas_rnn", False)
+        ref, _ = net.forward(params, {"x": x}, outputs=["r"])
+        set_flag("use_pallas_rnn", True)
+        out, _ = net.forward(params, {"x": x}, outputs=["r"])
+        np.testing.assert_allclose(
+            np.asarray(out["r"].value), np.asarray(ref["r"].value), atol=1e-5
+        )

@@ -1,0 +1,234 @@
+"""Tensor-parallel, sequence-parallel (ring/Ulysses) and sharded-embedding
+tests on the virtual 8-device CPU mesh (SURVEY.md §4 takeaway (3))."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    make_mesh,
+    set_mesh,
+)
+from paddle_tpu.parallel import (
+    Sharder,
+    dense_attention,
+    embedding_lookup,
+    ring_attention,
+    ulysses_attention,
+)
+from paddle_tpu.parallel.sparse import apply_rows, touched_rows
+
+
+def rand(key, *shape):
+    return jax.random.normal(jax.random.key(key), shape, jnp.float32)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+        B, T, H, D = 4, 16, 2, 8
+        q, k, v = rand(0, B, T, H, D), rand(1, B, T, H, D), rand(2, B, T, H, D)
+        ref = dense_attention(q, k, v, causal=causal)
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_kv_lens_mask(self):
+        mesh = make_mesh({SEQ_AXIS: 8})
+        B, T, H, D = 3, 16, 2, 4
+        q, k, v = rand(3, B, T, H, D), rand(4, B, T, H, D), rand(5, B, T, H, D)
+        lens = jnp.array([16, 9, 1], jnp.int32)
+        ref = dense_attention(q, k, v, kv_len=lens)
+        out = ring_attention(q, k, v, mesh, kv_lens=lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_grad_flows(self):
+        mesh = make_mesh({SEQ_AXIS: 4})
+        B, T, H, D = 2, 8, 2, 4
+        q, k, v = rand(6, B, T, H, D), rand(7, B, T, H, D), rand(8, B, T, H, D)
+
+        def loss_ring(q):
+            return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+        def loss_dense(q):
+            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss_ring)(q)
+        g2 = jax.grad(loss_dense)(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        mesh = make_mesh({SEQ_AXIS: 4})
+        B, T, H, D = 2, 16, 4, 8  # heads divisible by seq shards
+        q, k, v = rand(0, B, T, H, D), rand(1, B, T, H, D), rand(2, B, T, H, D)
+        lens = jnp.array([16, 11], jnp.int32)
+        ref = dense_attention(q, k, v, causal=causal, kv_len=lens)
+        out = ulysses_attention(q, k, v, mesh, causal=causal, kv_lens=lens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+class TestShardedEmbedding:
+    def test_lookup_matches_take(self):
+        mesh = make_mesh({MODEL_AXIS: 8})
+        V, D = 64, 5
+        table = rand(0, V, D)
+        ids = jnp.array([[0, 5, 63], [7, 8, 9]], jnp.int32)
+        out = embedding_lookup(table, ids, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(jnp.take(table, ids, axis=0)), atol=1e-6
+        )
+
+    def test_backward_is_row_sparse(self):
+        mesh = make_mesh({MODEL_AXIS: 4})
+        V, D = 16, 3
+        table = rand(1, V, D)
+        ids = jnp.array([1, 3, 3], jnp.int32)
+
+        g = jax.grad(
+            lambda t: jnp.sum(embedding_lookup(t, ids, mesh) * 2.0)
+        )(table)
+        ref = jax.grad(lambda t: jnp.sum(jnp.take(t, ids, axis=0) * 2.0))(table)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(ref), atol=1e-6)
+        # untouched rows get exactly zero gradient
+        assert float(jnp.abs(g[0]).sum()) == 0.0
+
+    def test_apply_rows_touched_only(self):
+        V, D = 8, 2
+        p = rand(2, V, D)
+        grad = jnp.ones((V, D))
+        t = touched_rows(jnp.array([2, 5]), V)
+        new = apply_rows(lambda p, g: p - 0.1 * g, p, grad, t)
+        np.testing.assert_allclose(np.asarray(new[2]), np.asarray(p[2] - 0.1))
+        np.testing.assert_allclose(np.asarray(new[0]), np.asarray(p[0]))
+
+
+class TestTensorParallelTraining:
+    def test_dp_model_mesh_matches_single_device(self):
+        """Same data, same init: a dp=2 × model=4 mesh training step must
+        match the unsharded step (the exact-parity discipline of
+        test_CompareTwoNets / checkRemoteParameterUpdater)."""
+        from paddle_tpu.core.arg import id_arg, non_seq
+        from paddle_tpu.core.config import OptimizationConf
+        from paddle_tpu.dsl import (
+            classification_cost,
+            data,
+            embedding,
+            fc,
+            model,
+        )
+        from paddle_tpu.network import Network
+        from paddle_tpu.optimizers import create_optimizer
+        from paddle_tpu.parallel.dp import TrainStep
+
+        def make(mesh=None):
+            with model() as m:
+                x = data("x", dim=(16,))
+                ids = data("ids", dim=(), is_ids=True)
+                emb = embedding(ids, size=8, vocab_size=32, sharded=True)
+                h = fc(x, emb, size=16, act="relu", name="h")
+                out = fc(h, size=4, act="softmax", name="out")
+                lbl = data("label", dim=(), is_ids=True)
+                classification_cost(out, lbl)
+            net = Network(m.conf)
+            params = net.init_params(jax.random.key(0))
+            opt = create_optimizer(
+                OptimizationConf(learning_method="sgd", learning_rate=0.1),
+                net.param_confs,
+            )
+            ostate = opt.init_state(params)
+            step = TrainStep(net, opt, mesh=mesh, donate=False)
+            if mesh is not None:
+                params, ostate, _ = step.place(params, ostate, {})
+            return net, step, params, ostate
+
+        rng = np.random.default_rng(0)
+        feed = {
+            "x": non_seq(jnp.asarray(rng.standard_normal((8, 16)), jnp.float32)),
+            "ids": id_arg(rng.integers(0, 32, 8)),
+            "label": id_arg(rng.integers(0, 4, 8)),
+        }
+        key = jax.random.key(9)
+
+        _, step1, p1, o1 = make(mesh=None)
+        p1, o1, _, loss1, _ = step1(p1, o1, {}, feed, 0, key)
+
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+        set_mesh(mesh)
+        _, stepN, pN, oN = make(mesh=mesh)
+        pN, oN, _, lossN, _ = stepN(pN, oN, {}, feed, 0, key)
+
+        np.testing.assert_allclose(float(loss1), float(lossN), rtol=1e-5)
+        for name in p1:
+            np.testing.assert_allclose(
+                np.asarray(p1[name]),
+                np.asarray(jax.device_get(pN[name])),
+                atol=1e-5,
+                err_msg=name,
+            )
+
+    def test_sharder_rules(self):
+        from paddle_tpu.core.config import ParameterConf
+
+        mesh = make_mesh({DATA_AXIS: 2, MODEL_AXIS: 4})
+        s = Sharder(mesh, rules=[(r"special", P(MODEL_AXIS, None))])
+        w = ParameterConf(name="_h.w0", dims=(16, 8))
+        emb = ParameterConf(
+            name="_e.w0", dims=(32, 8), sparse_remote_update=True
+        )
+        bad = ParameterConf(name="_o.w0", dims=(7, 9))  # indivisible
+        spec_w = s.spec(w.name, w)
+        assert spec_w == P(None, MODEL_AXIS)
+        assert s.spec(emb.name, emb) == P(MODEL_AXIS, None)
+        assert s.spec(bad.name, bad) == P()
+        assert s.spec("special.w", bad) == P(MODEL_AXIS, None)
+
+
+class TestAttentionLayer:
+    @pytest.mark.parametrize("mode", ["none", "ring", "ulysses"])
+    def test_layer_modes_agree(self, mode):
+        from paddle_tpu.core.arg import seq
+        from paddle_tpu.core.config import (
+            InputConf,
+            LayerConf,
+            ModelConf,
+        )
+        from paddle_tpu.network import Network
+
+        mesh = make_mesh({DATA_AXIS: 2, SEQ_AXIS: 4})
+        set_mesh(mesh)
+        B, T, D = 4, 8, 16
+        conf = ModelConf(
+            layers=[
+                LayerConf(name="x", type="data", attrs={"dim": (D,), "is_seq": True}),
+                LayerConf(
+                    name="att",
+                    type="multi_head_attention",
+                    size=D,
+                    bias=False,
+                    inputs=[InputConf(name="x")],
+                    attrs={"num_heads": 4, "causal": True, "seq_parallel": mode},
+                ),
+            ]
+        )
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        x = seq(
+            jax.random.normal(jax.random.key(1), (B, T, D)),
+            jnp.array([8, 8, 5, 2], jnp.int32),
+        )
+        outs, _ = net.forward(params, {"x": x}, outputs=["att"])
+        if not hasattr(self, "_ref"):
+            type(self)._ref = {}
+        type(self)._ref[mode] = np.asarray(outs["att"].value)
+        if "none" in self._ref and mode != "none":
+            np.testing.assert_allclose(
+                self._ref[mode], self._ref["none"], atol=1e-5
+            )
