@@ -175,3 +175,70 @@ def batched(reader_fn, batch_size, drop_last=True):
             yield buf
 
     return reader
+
+
+def recordio(paths, decode=None, start_chunk=0, step_chunk=1):
+    """Stream records from chunked record files through the native C++
+    async-prefetch reader (paddle_tpu/native/recordio.py — the
+    DoubleBuffer analogue, gserver/dataproviders/DataProvider.h:249).
+    `decode` maps raw bytes -> sample (default: pickle.loads)."""
+    import pickle
+
+    from paddle_tpu.native.recordio import RecordReader
+
+    dec = decode if decode is not None else pickle.loads
+
+    def reader():
+        with RecordReader(
+            paths, start_chunk=start_chunk, step_chunk=step_chunk
+        ) as rd:
+            for rec in rd:
+                yield dec(rec)
+
+    return reader
+
+
+def elastic(master, decode=None):
+    """Task-leased reading: pull (path, chunk) tasks from a
+    paddle_tpu.native.master.Master and stream those chunks — the
+    fault-tolerant input dispatch loop of the reference's Go master
+    (go/master/service.go). On reader failure the task lease expires and
+    another worker re-reads the chunk."""
+    import json
+    import pickle
+
+    from paddle_tpu.native.recordio import RecordReader, count_chunks
+
+    dec = decode if decode is not None else pickle.loads
+
+    def reader():
+        import time
+
+        chunk_counts = {}
+        while not master.pass_finished():
+            t = master.get_task()
+            if t is None:
+                # nothing leasable *right now*, but a peer still holds a
+                # lease — if it fails, the chunk returns to todo and we
+                # must pick it up, so poll instead of exiting
+                time.sleep(0.05)
+                continue
+            task_id, payload = t
+            task = json.loads(payload)
+            path = task["path"]
+            if path not in chunk_counts:
+                chunk_counts[path] = count_chunks(path)
+            try:
+                with RecordReader(
+                    path,
+                    start_chunk=task["chunk"],
+                    step_chunk=chunk_counts[path],
+                ) as rd:
+                    for rec in rd:
+                        yield dec(rec)
+            except Exception:
+                master.task_failed(task_id)
+                raise
+            master.task_done(task_id)
+
+    return reader

@@ -1,0 +1,137 @@
+"""Native runtime loader: builds (if needed) and binds the C++ shared
+library via ctypes.
+
+The reference's native runtime pieces this library reproduces:
+- paddle/optimizer/ — standalone C-ABI optimizer lib (used there by the
+  Go pserver through cgo; here by host-side updaters and checkpoints),
+- RecordIO chunk IO + DoubleBuffer async prefetch
+  (go/master/service.go:280, gserver/dataproviders/DataProvider.h:249),
+- the elastic master task queue (go/master/service.go).
+
+No pybind11 in this image — plain ctypes over an `extern "C"` ABI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "lib", "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src = os.path.join(_DIR, "src")
+    return any(
+        os.path.getmtime(os.path.join(src, f)) > lib_mtime
+        for f in os.listdir(src)
+    )
+
+
+def build() -> str:
+    """Compile the shared library (idempotent, mtime-cached). A file
+    lock serializes concurrent builds across processes; the Makefile
+    additionally renames the .so into place atomically."""
+    if _needs_build():
+        import fcntl
+
+        os.makedirs(os.path.join(_DIR, "lib"), exist_ok=True)
+        lock_path = os.path.join(_DIR, "lib", ".build.lock")
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if _needs_build():  # re-check under the lock
+                    subprocess.run(
+                        ["make", "-s", "-C", _DIR],
+                        check=True,
+                        capture_output=True,
+                        text=True,
+                    )
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+    return _LIB_PATH
+
+
+def load() -> ctypes.CDLL:
+    """Build if stale and dlopen; memoized."""
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        lib = ctypes.CDLL(build())
+
+        c = ctypes
+        i64, f64, i32 = c.c_int64, c.c_double, c.c_int
+        p = c.c_void_p
+        cp = c.c_char_p
+
+        # optimizer
+        lib.pt_optimizer_create.restype = p
+        lib.pt_optimizer_create.argtypes = [
+            cp, i64, f64, f64, f64, f64, f64, f64, f64, cp, f64, f64,
+        ]
+        lib.pt_optimizer_destroy.argtypes = [p]
+        lib.pt_optimizer_update.argtypes = [
+            p, c.POINTER(c.c_float), c.POINTER(c.c_float), i64, i64,
+        ]
+        lib.pt_optimizer_state_size.restype = i64
+        lib.pt_optimizer_state_size.argtypes = [p]
+        lib.pt_optimizer_get_state.restype = i64
+        lib.pt_optimizer_get_state.argtypes = [p, cp, i64]
+        lib.pt_optimizer_set_state.restype = i32
+        lib.pt_optimizer_set_state.argtypes = [p, cp, i64]
+
+        # recordio
+        lib.pt_recordio_writer_open.restype = p
+        lib.pt_recordio_writer_open.argtypes = [cp, i64]
+        lib.pt_recordio_write.restype = i32
+        lib.pt_recordio_write.argtypes = [p, cp, i64]
+        lib.pt_recordio_writer_close.restype = i32
+        lib.pt_recordio_writer_close.argtypes = [p]
+        lib.pt_recordio_reader_open.restype = p
+        lib.pt_recordio_reader_open.argtypes = [
+            c.POINTER(cp), i32, i32, i32, i32,
+        ]
+        lib.pt_recordio_next.restype = i64
+        lib.pt_recordio_next.argtypes = [p, c.c_char_p, i64]
+        lib.pt_recordio_peek_len.restype = i64
+        lib.pt_recordio_peek_len.argtypes = [p]
+        lib.pt_recordio_error.restype = cp
+        lib.pt_recordio_error.argtypes = [p]
+        lib.pt_recordio_reader_close.argtypes = [p]
+        lib.pt_recordio_count_chunks.restype = i64
+        lib.pt_recordio_count_chunks.argtypes = [cp]
+
+        # master
+        lib.pt_master_create.restype = p
+        lib.pt_master_create.argtypes = [f64, i32]
+        lib.pt_master_destroy.argtypes = [p]
+        lib.pt_master_add_task.restype = i64
+        lib.pt_master_add_task.argtypes = [p, cp, i64]
+        lib.pt_master_get_task.restype = i64
+        lib.pt_master_get_task.argtypes = [p, c.c_char_p, i64, c.POINTER(i64)]
+        lib.pt_master_task_done.restype = i32
+        lib.pt_master_task_done.argtypes = [p, i64]
+        lib.pt_master_task_failed.restype = i32
+        lib.pt_master_task_failed.argtypes = [p, i64]
+        lib.pt_master_pass_finished.restype = i32
+        lib.pt_master_pass_finished.argtypes = [p]
+        lib.pt_master_start_pass.restype = i64
+        lib.pt_master_start_pass.argtypes = [p]
+        lib.pt_master_count.restype = i64
+        lib.pt_master_count.argtypes = [p, i32]
+        lib.pt_master_set_lease.argtypes = [p, f64]
+        lib.pt_master_snapshot.restype = i32
+        lib.pt_master_snapshot.argtypes = [p, cp]
+        lib.pt_master_restore.restype = p
+        lib.pt_master_restore.argtypes = [cp]
+
+        _lib = lib
+        return _lib

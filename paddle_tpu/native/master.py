@@ -1,0 +1,106 @@
+"""ctypes wrapper for the elastic task-queue master.
+
+Fault-tolerant input dispatch (go/master/service.go capability): chunk
+tasks leased to workers, timeout requeue, failure cap, pass rotation,
+snapshot/restore. Typical use: the coordinator host owns a Master over
+(file, chunk) tasks; trainer processes lease tasks, read those chunks via
+RecordReader(start_chunk=..., step_chunk=...), and report done/failed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Optional
+
+from paddle_tpu.native import load
+
+_CAP = 1 << 20
+
+
+class Master:
+    def __init__(
+        self,
+        lease_seconds: float = 60.0,
+        failure_max: int = 3,
+        _handle=None,
+    ):
+        self._lib = load()
+        self._h = (
+            _handle
+            if _handle is not None
+            else self._lib.pt_master_create(lease_seconds, failure_max)
+        )
+
+    # ---- task lifecycle ----
+    def add_task(self, payload: bytes) -> int:
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return self._lib.pt_master_add_task(self._h, payload, len(payload))
+
+    def add_chunk_tasks(self, path: str, num_chunks: int) -> None:
+        """One task per chunk of a record file (the Go master's dataset
+        partitioning, service.go:280)."""
+        for i in range(num_chunks):
+            self.add_task(json.dumps({"path": path, "chunk": i}).encode())
+
+    def get_task(self) -> Optional[tuple]:
+        """Lease a task: (task_id, payload), or None when nothing is
+        leasable right now (empty payloads are valid tasks)."""
+        buf = ctypes.create_string_buffer(_CAP)
+        tid = ctypes.c_int64(0)
+        n = self._lib.pt_master_get_task(
+            self._h, buf, _CAP, ctypes.byref(tid)
+        )
+        if n == -3:
+            return None
+        if n < 0:
+            raise RuntimeError(f"get_task failed (code {n})")
+        return tid.value, buf.raw[:n]
+
+    def task_done(self, task_id: int) -> bool:
+        """False if the lease had already expired (task was requeued)."""
+        return self._lib.pt_master_task_done(self._h, task_id) == 0
+
+    def task_failed(self, task_id: int) -> bool:
+        return self._lib.pt_master_task_failed(self._h, task_id) == 0
+
+    # ---- pass control ----
+    def pass_finished(self) -> bool:
+        return self._lib.pt_master_pass_finished(self._h) == 1
+
+    def start_pass(self) -> int:
+        """Rotate done tasks back into todo; returns todo count."""
+        return self._lib.pt_master_start_pass(self._h)
+
+    # ---- introspection ----
+    @property
+    def counts(self) -> dict:
+        c = self._lib.pt_master_count
+        return {
+            "todo": c(self._h, 0),
+            "pending": c(self._h, 1),
+            "done": c(self._h, 2),
+            "discarded": c(self._h, 3),
+        }
+
+    def set_lease(self, seconds: float) -> None:
+        self._lib.pt_master_set_lease(self._h, seconds)
+
+    # ---- durability ----
+    def snapshot(self, path: str) -> None:
+        if self._lib.pt_master_snapshot(self._h, path.encode()) != 0:
+            raise IOError(f"snapshot to {path} failed")
+
+    @classmethod
+    def restore(cls, path: str) -> "Master":
+        h = load().pt_master_restore(path.encode())
+        if not h:
+            raise IOError(f"cannot restore master from {path}")
+        return cls(_handle=h)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.pt_master_destroy(h)
+            self._h = None
