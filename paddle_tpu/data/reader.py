@@ -239,6 +239,16 @@ def elastic(master, decode=None):
             except Exception:
                 master.task_failed(task_id)
                 raise
-            master.task_done(task_id)
+            if not master.task_done(task_id):
+                # lease expired while we were yielding: the chunk was
+                # requeued and will be re-read (duplicate records this
+                # pass) — surface it so the operator can raise the lease
+                import logging
+
+                logging.getLogger("paddle_tpu.data").warning(
+                    "task %d lease expired before completion; chunk will "
+                    "be re-served (raise Master lease_seconds?)",
+                    task_id,
+                )
 
     return reader

@@ -233,5 +233,325 @@ class AucEvaluator(Evaluator):
         return float(np.trapezoid(tpr, fpr))
 
 
+@EVALUATORS.register("seq_classification_error")
+class SequenceClassificationErrorEvaluator(Evaluator):
+    """Sequence-level classification error (Evaluator.cpp:135
+    SequenceClassificationErrorEvaluator): a sequence counts as wrong if
+    ANY frame in it is wrong."""
+
+    def start(self):
+        self.wrong = 0.0
+        self.total = 0.0
+
+    def add_batch(self, outs, feed):
+        pred = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        p = np.asarray(pred.value)  # [B,T,C]
+        l = np.asarray(label.ids if label.ids is not None else label.value)
+        l = l.reshape(p.shape[0], p.shape[1])
+        m = np.asarray(pred.mask())
+        frame_err = (np.argmax(p, axis=-1) != l) & (m > 0)
+        self.wrong += float((frame_err.any(axis=-1)).sum())
+        self.total += p.shape[0]
+
+    def result(self):
+        return self.wrong / max(self.total, 1.0)
+
+
+@EVALUATORS.register("chunk")
+class ChunkEvaluator(Evaluator):
+    """IOB/IOE/IOBES/plain chunking F1 (ChunkEvaluator.cpp). A chunk is
+    correct iff begin, end, and type all match. Label encoding (the
+    reference's): tag = label % num_tag_types, type = label // num_tag_types,
+    with the "other" type == num_chunk_types. conf: chunk_scheme,
+    num_chunk_types, excluded_chunk_types, input (decoded ids), label."""
+
+    SCHEMES = {
+        # scheme: (num_tag_types, begin, inside, end, single)
+        "plain": (1, -1, -1, -1, -1),
+        "IOB": (2, 0, 1, -1, -1),
+        "IOE": (2, -1, 0, 1, -1),
+        "IOBES": (4, 0, 1, 2, 3),
+    }
+
+    def start(self):
+        scheme = self.conf.get("chunk_scheme", "IOB")
+        (
+            self.num_tag,
+            self.tag_b,
+            self.tag_i,
+            self.tag_e,
+            self.tag_s,
+        ) = self.SCHEMES[scheme]
+        self.num_chunk_types = self.conf["num_chunk_types"]
+        self.other = self.num_chunk_types
+        self.excluded = set(self.conf.get("excluded_chunk_types", ()))
+        self.n_label = 0
+        self.n_output = 0
+        self.n_correct = 0
+
+    # -- chunk boundary rules (ChunkEvaluator.cpp:225-245), data not code --
+    def _is_end(self, ptag, ptype, tag, typ):
+        if ptype == self.other:
+            return False
+        if typ == self.other or typ != ptype:
+            return True
+        if ptag in (self.tag_b, self.tag_i) and ptag >= 0:
+            return tag in (self.tag_b, self.tag_s) and tag >= 0
+        return ptag in (self.tag_e, self.tag_s) and ptag >= 0
+
+    def _is_begin(self, ptag, ptype, tag, typ):
+        if ptype == self.other:
+            return typ != self.other
+        if typ == self.other:
+            return False
+        if typ != ptype:
+            return True
+        if tag == self.tag_b or tag == self.tag_s:
+            return True
+        if tag in (self.tag_i, self.tag_e) and tag >= 0:
+            return ptag in (self.tag_e, self.tag_s) and ptag >= 0
+        return False
+
+    def _segments(self, labels):
+        segs, in_chunk, start = [], False, 0
+        tag, typ = -1, self.other
+        for i, lab in enumerate(labels):
+            ptag, ptype = tag, typ
+            tag, typ = int(lab) % self.num_tag, int(lab) // self.num_tag
+            if in_chunk and self._is_end(ptag, ptype, tag, typ):
+                segs.append((start, i - 1, ptype))
+                in_chunk = False
+            if self._is_begin(ptag, ptype, tag, typ):
+                start, in_chunk = i, True
+        if in_chunk:
+            segs.append((start, len(labels) - 1, typ))
+        return segs
+
+    def _eval_seq(self, out, lab):
+        o, l = self._segments(out), self._segments(lab)
+        correct = set(o) & set(l)
+        self.n_correct += sum(1 for s in correct if s[2] not in self.excluded)
+        self.n_output += sum(1 for s in o if s[2] not in self.excluded)
+        self.n_label += sum(1 for s in l if s[2] not in self.excluded)
+
+    def add_batch(self, outs, feed):
+        pred = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        p = np.asarray(pred.ids if pred.ids is not None else pred.value)
+        p = p.reshape(p.shape[0], -1)
+        l = np.asarray(label.ids).reshape(p.shape[0], -1)
+        lens = np.asarray(label.seq_lens)
+        for b in range(p.shape[0]):
+            n = int(lens[b])
+            self._eval_seq(p[b, :n], l[b, :n])
+
+    def result(self):
+        prec = self.n_correct / max(self.n_output, 1)
+        rec = self.n_correct / max(self.n_label, 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return {"precision": prec, "recall": rec, "F1": f1}
+
+
+def _edit_distance(ref, hyp):
+    """Levenshtein with (sub, del, ins) backtrace counts
+    (CTCErrorEvaluator.cpp stringAlignment)."""
+    n, m = len(ref), len(hyp)
+    if n == 0:
+        return m, 0, 0, m
+    if m == 0:
+        return n, 0, n, 0
+    d = np.zeros((n + 1, m + 1), np.int64)
+    d[:, 0] = np.arange(n + 1)
+    d[0, :] = np.arange(m + 1)
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            c = 0 if ref[i - 1] == hyp[j - 1] else 1
+            d[i, j] = min(d[i - 1, j - 1] + c, d[i - 1, j] + 1, d[i, j - 1] + 1)
+    subs = dels = ins = 0
+    i, j = n, m
+    while i and j:
+        if d[i, j] == d[i - 1, j - 1] and ref[i - 1] == hyp[j - 1]:
+            i, j = i - 1, j - 1
+        elif d[i, j] == d[i - 1, j - 1] + 1:
+            subs += 1
+            i, j = i - 1, j - 1
+        elif d[i, j] == d[i - 1, j] + 1:
+            dels += 1
+            i -= 1
+        else:
+            ins += 1
+            j -= 1
+    dels += i
+    ins += j
+    return int(d[n, m]), subs, dels, ins
+
+
+@EVALUATORS.register("ctc_edit_distance")
+class CTCErrorEvaluator(Evaluator):
+    """Sequence edit-distance error for CTC models (CTCErrorEvaluator.cpp):
+    per sequence, best-path decode (argmax per frame, collapse — reuses
+    ops.ctc.ctc_greedy_decode so train-time and eval-time decode agree),
+    then length-normalized Levenshtein vs the label string. conf "blank"
+    defaults to 0 like this framework's ctc layer (the reference hardcodes
+    blank = C-1; set blank=C-1 in conf for that convention). result: dict
+    with avg normalized edit distance plus insertion/deletion/substitution
+    rates and whole-seq error rate."""
+
+    def start(self):
+        self.total_err = 0.0
+        self.ins = self.dels = self.subs = 0.0
+        self.seq_err = 0
+        self.n_seq = 0
+
+    def add_batch(self, outs, feed):
+        from paddle_tpu.ops.ctc import ctc_greedy_decode
+
+        act = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        a = np.asarray(act.value)  # [B,T,C]
+        alens = np.asarray(act.seq_lens)
+        l = np.asarray(label.ids).reshape(a.shape[0], -1)
+        llens = np.asarray(label.seq_lens)
+        blank = self.conf.get("blank", 0)
+        paths, plens = ctc_greedy_decode(
+            jnp.asarray(a), jnp.asarray(alens, jnp.int32), blank=blank
+        )
+        paths, plens = np.asarray(paths), np.asarray(plens)
+        for b in range(a.shape[0]):
+            hyp = paths[b, : int(plens[b])].tolist()
+            ref = l[b, : int(llens[b])].tolist()
+            dist, subs, dels, ins = _edit_distance(ref, hyp)
+            mx = max(len(ref), len(hyp), 1)
+            self.total_err += dist / mx
+            self.subs += subs / mx
+            self.dels += dels / mx
+            self.ins += ins / mx
+            self.seq_err += int(dist != 0)
+            self.n_seq += 1
+
+    def result(self):
+        n = max(self.n_seq, 1)
+        return {
+            "edit_distance": self.total_err / n,
+            "substitution": self.subs / n,
+            "deletion": self.dels / n,
+            "insertion": self.ins / n,
+            "seq_error": self.seq_err / n,
+        }
+
+
+class _PrinterBase(Evaluator):
+    """Printers (Evaluator.cpp:1009-1346) log tensors for debugging; they
+    accumulate nothing. Output goes through `emit` (logging by default,
+    or a user-supplied `printer` callable / `result_file` in conf)."""
+
+    def start(self):
+        self.lines = []
+
+    def emit(self, line: str):
+        self.lines.append(line)
+        f = self.conf.get("printer")
+        if f is not None:
+            f(line)
+        else:
+            import logging
+
+            logging.getLogger("paddle_tpu.eval").info("%s: %s", self.name, line)
+
+    def result(self):
+        path = self.conf.get("result_file")
+        if path:
+            with open(path, "a") as fh:
+                fh.write("\n".join(self.lines) + "\n")
+        return None
+
+
+@EVALUATORS.register("value_printer")
+class ValuePrinter(_PrinterBase):
+    def add_batch(self, outs, feed):
+        x = self._get(outs, feed, "input")
+        v = x.value if x.value is not None else x.ids
+        self.emit(np.array2string(np.asarray(v), threshold=64))
+
+
+@EVALUATORS.register("gradient_printer")
+class GradientPrinter(_PrinterBase):
+    """The reference prints a layer's output gradient. Gradients here are
+    functional (jax.grad over the net) — intermediate output grads are
+    recorded into `outs["<name>@GRAD"]` when the trainer is run with
+    grad taps; fall back to value stats otherwise."""
+
+    def add_batch(self, outs, feed):
+        g = outs.get(self.conf["input"] + "@GRAD")
+        if g is not None:
+            self.emit(np.array2string(np.asarray(g.value), threshold=64))
+        else:
+            x = self._get(outs, feed, "input")
+            self.emit(
+                "[no grad tap] value mean=%.6g std=%.6g"
+                % (np.mean(x.value), np.std(np.asarray(x.value)))
+            )
+
+
+@EVALUATORS.register("max_id_printer")
+class MaxIdPrinter(_PrinterBase):
+    def add_batch(self, outs, feed):
+        x = self._get(outs, feed, "input")
+        self.emit(str(np.argmax(np.asarray(x.value), axis=-1).tolist()))
+
+
+@EVALUATORS.register("max_frame_printer")
+class MaxFramePrinter(_PrinterBase):
+    """Prints, per sequence, the frame with the max value."""
+
+    def add_batch(self, outs, feed):
+        x = self._get(outs, feed, "input")
+        v = np.asarray(x.value)
+        m = np.asarray(x.mask())
+        score = (v.max(axis=-1) * m) + (m - 1) * 1e30
+        self.emit(str(np.argmax(score, axis=-1).tolist()))
+
+
+@EVALUATORS.register("seq_text_printer")
+class SequenceTextPrinter(_PrinterBase):
+    """Prints id sequences as text (Evaluator.cpp:1181). conf: input,
+    optional dict_file (one token per line) mapping ids to words."""
+
+    def start(self):
+        super().start()
+        self.vocab = None
+        df = self.conf.get("dict_file")
+        if df:
+            with open(df) as fh:
+                self.vocab = [ln.rstrip("\n") for ln in fh]
+
+    def add_batch(self, outs, feed):
+        x = self._get(outs, feed, "input")
+        ids = np.asarray(x.ids if x.ids is not None else x.value)
+        ids = ids.reshape(ids.shape[0], -1)
+        lens = (
+            np.asarray(x.seq_lens)
+            if x.seq_lens is not None
+            else np.full(ids.shape[0], ids.shape[1])
+        )
+        for b in range(ids.shape[0]):
+            seq = ids[b, : int(lens[b])].tolist()
+            if self.vocab:
+                self.emit(" ".join(self.vocab[i] for i in seq))
+            else:
+                self.emit(" ".join(str(i) for i in seq))
+
+
+@EVALUATORS.register("classification_error_printer")
+class ClassificationErrorPrinter(_PrinterBase):
+    def add_batch(self, outs, feed):
+        pred = self._get(outs, feed, "input")
+        label = self._get(outs, feed, "label")
+        p, l, w = self._masked_pairs(pred, label)
+        err = ((np.argmax(p, axis=-1) != l) & (w > 0)).astype(np.int64)
+        self.emit(str(err.tolist()))
+
+
 def create_evaluator(conf: dict) -> Evaluator:
     return EVALUATORS.get(conf["type"])(conf)

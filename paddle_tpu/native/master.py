@@ -47,16 +47,21 @@ class Master:
     def get_task(self) -> Optional[tuple]:
         """Lease a task: (task_id, payload), or None when nothing is
         leasable right now (empty payloads are valid tasks)."""
-        buf = ctypes.create_string_buffer(_CAP)
-        tid = ctypes.c_int64(0)
-        n = self._lib.pt_master_get_task(
-            self._h, buf, _CAP, ctypes.byref(tid)
-        )
-        if n == -3:
-            return None
-        if n < 0:
-            raise RuntimeError(f"get_task failed (code {n})")
-        return tid.value, buf.raw[:n]
+        cap = _CAP
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            tid = ctypes.c_int64(0)
+            n = self._lib.pt_master_get_task(
+                self._h, buf, cap, ctypes.byref(tid)
+            )
+            if n == -3:
+                return None
+            if n == -1:  # buffer too small; tid holds the required size
+                cap = tid.value
+                continue
+            if n < 0:
+                raise RuntimeError(f"get_task failed (code {n})")
+            return tid.value, buf.raw[:n]
 
     def task_done(self, task_id: int) -> bool:
         """False if the lease had already expired (task was requeued)."""

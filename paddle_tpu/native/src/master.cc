@@ -112,15 +112,20 @@ int64_t pt_master_add_task(Master* m, const char* payload, int64_t len) {
 }
 
 // Lease the next task. Returns payload length (>= 0; empty payloads are
-// valid), -3 if no task is currently available, -1 if buf too small.
-// task_id receives the lease id to report done/failed against.
+// valid), -3 if no task is currently available, -1 if buf too small (the
+// task is NOT leased; *task_id receives the required size so the caller
+// can retry with a larger buffer instead of wedging the queue head).
+// On success task_id receives the lease id to report done/failed against.
 int64_t pt_master_get_task(Master* m, char* buf, int64_t cap,
                            int64_t* task_id) {
   std::lock_guard<std::mutex> l(m->mu);
   m->requeue_expired_locked();
   if (m->todo.empty()) return -3;
   Task& t = m->todo.front();
-  if (static_cast<int64_t>(t.payload.size()) > cap) return -1;
+  if (static_cast<int64_t>(t.payload.size()) > cap) {
+    *task_id = static_cast<int64_t>(t.payload.size());
+    return -1;
+  }
   int64_t n = static_cast<int64_t>(t.payload.size());
   std::memcpy(buf, t.payload.data(), t.payload.size());
   int64_t lease = m->next_lease++;

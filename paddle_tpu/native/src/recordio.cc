@@ -160,7 +160,13 @@ struct Reader {
       }
       if (stop.load()) break;
     }
-    done.store(true);
+    {
+      // set under mu: a consumer that just evaluated its wait predicate
+      // (queue empty, done false) must not be able to block after this
+      // store without seeing the notify (lost-wakeup)
+      std::lock_guard<std::mutex> l(mu);
+      done.store(true);
+    }
     cv_can_pop.notify_all();
   }
 };
@@ -237,7 +243,12 @@ const char* pt_recordio_error(Reader* r) {
 }
 
 void pt_recordio_reader_close(Reader* r) {
-  r->stop.store(true);
+  {
+    // set under mu so the worker can't block on a full queue between
+    // evaluating its wait predicate and this store (lost-wakeup)
+    std::lock_guard<std::mutex> l(r->mu);
+    r->stop.store(true);
+  }
   r->cv_can_push.notify_all();
   r->cv_can_pop.notify_all();
   if (r->worker.joinable()) r->worker.join();
@@ -248,6 +259,9 @@ void pt_recordio_reader_close(Reader* r) {
 int64_t pt_recordio_count_chunks(const char* path) {
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
   int64_t count = 0;
   for (;;) {
     char hdr[16];
@@ -258,7 +272,12 @@ int64_t pt_recordio_count_chunks(const char* path) {
     std::memcpy(&magic, hdr, 4);
     std::memcpy(&plen, hdr + 8, 4);
     if (magic != kChunkMagic) { count = -2; break; }
-    if (fseek(f, plen, SEEK_CUR) != 0) { count = -2; break; }
+    // fseek past EOF "succeeds" on regular files — a truncated final
+    // chunk must be a partition-time error, not N worker lease failures
+    if (fseek(f, plen, SEEK_CUR) != 0 || ftell(f) > fsize) {
+      count = -2;
+      break;
+    }
     count++;
   }
   fclose(f);

@@ -1,0 +1,196 @@
+"""Evaluator parity tests — mirror the reference's evaluator semantics
+(gserver/evaluators/Evaluator.cpp, ChunkEvaluator.cpp,
+CTCErrorEvaluator.cpp)."""
+
+import numpy as np
+
+from paddle_tpu.core.arg import Arg, id_arg, non_seq, seq
+from paddle_tpu.evaluators import _edit_distance, create_evaluator
+from paddle_tpu.ops.ctc import ctc_greedy_decode
+
+
+def _feed(pred, label, **extra):
+    d = {"out": pred, "lbl": label}
+    d.update(extra)
+    return {}, d
+
+
+def test_classification_error_and_seq_variant():
+    # 2 seqs, len 3 and 2; frame errors: seq0 has 1 wrong, seq1 all right
+    p = np.zeros((2, 3, 4), np.float32)
+    p[0, 0, 1] = 1  # pred 1, label 1 ok
+    p[0, 1, 2] = 1  # pred 2, label 0 wrong
+    p[0, 2, 3] = 1  # pred 3, label 3 ok
+    p[1, 0, 0] = 1
+    p[1, 1, 1] = 1
+    l = np.array([[1, 0, 3], [0, 1, 0]], np.int32)
+    pred = seq(p, [3, 2])
+    label = id_arg(l, seq_lens=[3, 2])
+
+    ev = create_evaluator(
+        {"type": "classification_error", "input": "out", "label": "lbl"}
+    )
+    ev.add_batch(*_feed(pred, label))
+    assert abs(ev.result() - 1.0 / 5.0) < 1e-9
+
+    ev = create_evaluator(
+        {"type": "seq_classification_error", "input": "out", "label": "lbl"}
+    )
+    ev.add_batch(*_feed(pred, label))
+    assert abs(ev.result() - 1.0 / 2.0) < 1e-9  # seq0 wrong, seq1 right
+
+
+def test_chunk_evaluator_iob_f1():
+    # IOB, 2 chunk types: labels B-0=0 I-0=1 B-1=2 I-1=3 O=4
+    # gold:   [B-0 I-0 O  B-1]   chunks: (0,1,t0), (3,3,t1)
+    # pred:   [B-0 I-0 O  B-0]   chunks: (0,1,t0), (3,3,t0)
+    gold = np.array([[0, 1, 4, 2]], np.int32)
+    pred = np.array([[0, 1, 4, 0]], np.int32)
+    ev = create_evaluator(
+        {
+            "type": "chunk",
+            "input": "out",
+            "label": "lbl",
+            "chunk_scheme": "IOB",
+            "num_chunk_types": 2,
+        }
+    )
+    ev.add_batch(
+        *_feed(id_arg(pred, seq_lens=[4]), id_arg(gold, seq_lens=[4]))
+    )
+    r = ev.result()
+    assert abs(r["precision"] - 0.5) < 1e-9
+    assert abs(r["recall"] - 0.5) < 1e-9
+    assert abs(r["F1"] - 0.5) < 1e-9
+
+
+def test_chunk_evaluator_iobes_and_plain():
+    # IOBES, 1 chunk type: B=0 I=1 E=2 S=3 O=4
+    gold = np.array([[0, 1, 2, 4, 3]], np.int32)  # chunks (0,2), (4,4)
+    ev = create_evaluator(
+        {
+            "type": "chunk",
+            "input": "out",
+            "label": "lbl",
+            "chunk_scheme": "IOBES",
+            "num_chunk_types": 1,
+        }
+    )
+    ev.add_batch(
+        *_feed(id_arg(gold, seq_lens=[5]), id_arg(gold, seq_lens=[5]))
+    )
+    r = ev.result()
+    assert r == {"precision": 1.0, "recall": 1.0, "F1": 1.0}
+
+    # plain, 2 types: label==2 is "other"; runs of same type are chunks
+    gold = np.array([[0, 0, 2, 1, 1]], np.int32)  # chunks (0,1,t0),(3,4,t1)
+    pred = np.array([[0, 0, 2, 1, 0]], np.int32)  # (0,1,t0),(3,3,t1),(4,4,t0)
+    ev = create_evaluator(
+        {
+            "type": "chunk",
+            "input": "out",
+            "label": "lbl",
+            "chunk_scheme": "plain",
+            "num_chunk_types": 2,
+        }
+    )
+    ev.add_batch(
+        *_feed(id_arg(pred, seq_lens=[5]), id_arg(gold, seq_lens=[5]))
+    )
+    r = ev.result()
+    assert abs(r["precision"] - 1.0 / 3.0) < 1e-9
+    assert abs(r["recall"] - 1.0 / 2.0) < 1e-9
+
+
+def _collapse_via_decode(path, blank):
+    """Best-path collapse via the shared ctc_greedy_decode kernel."""
+    t = len(path)
+    lp = np.full((1, t, max(path) + 1), -1e9, np.float32)
+    for i, c in enumerate(path):
+        lp[0, i, c] = 0.0
+    out, lens = ctc_greedy_decode(lp, np.array([t], np.int32), blank=blank)
+    return np.asarray(out)[0, : int(lens[0])].tolist()
+
+
+def test_ctc_collapse_and_edit_distance():
+    # blank=3: [3,1,1,3,1,2,3] -> [1,1,2]
+    assert _collapse_via_decode([3, 1, 1, 3, 1, 2, 3], 3) == [1, 1, 2]
+    assert _collapse_via_decode([1, 1, 2, 2], 3) == [1, 2]
+    d, s, dl, i = _edit_distance([1, 2, 3], [1, 3])
+    assert d == 1 and dl == 1 and s == 0 and i == 0
+    d, s, dl, i = _edit_distance([1, 2], [1, 3, 2])
+    assert d == 1 and i == 1
+    d, s, dl, i = _edit_distance([1, 2], [1, 3])
+    assert d == 1 and s == 1
+
+
+def test_ctc_edit_distance_evaluator():
+    # 1 seq, T=4, C=3 (blank=2). argmax path: [0, 2, 1, 1] -> [0, 1]
+    a = np.full((1, 4, 3), -1.0, np.float32)
+    a[0, 0, 0] = 1
+    a[0, 1, 2] = 1
+    a[0, 2, 1] = 1
+    a[0, 3, 1] = 1
+    label = id_arg(np.array([[0, 1]], np.int32), seq_lens=[2])
+    ev = create_evaluator(
+        {"type": "ctc_edit_distance", "input": "out", "label": "lbl",
+         "blank": 2}
+    )
+    ev.add_batch(*_feed(seq(a, [4]), label))
+    r = ev.result()
+    assert r["edit_distance"] == 0.0 and r["seq_error"] == 0.0
+
+    # wrong label -> 1 substitution over maxlen 2
+    ev.start()
+    label2 = id_arg(np.array([[0, 0]], np.int32), seq_lens=[2])
+    ev.add_batch(*_feed(seq(a, [4]), label2))
+    r = ev.result()
+    assert abs(r["edit_distance"] - 0.5) < 1e-9
+    assert r["seq_error"] == 1.0
+
+
+def test_printers_capture_lines():
+    lines = []
+    pr = lines.append
+    p = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+    l = np.array([1, 1], np.int32)
+    ev = create_evaluator({"type": "value_printer", "input": "out", "printer": pr})
+    ev.add_batch({}, {"out": non_seq(p)})
+    assert len(lines) == 1
+
+    ev = create_evaluator({"type": "max_id_printer", "input": "out", "printer": pr})
+    ev.add_batch({}, {"out": non_seq(p)})
+    assert lines[-1] == "[1, 0]"
+
+    ev = create_evaluator(
+        {
+            "type": "classification_error_printer",
+            "input": "out",
+            "label": "lbl",
+            "printer": pr,
+        }
+    )
+    ev.add_batch({}, {"out": non_seq(p), "lbl": id_arg(l)})
+    assert lines[-1] == "[0, 1]"
+
+    ev = create_evaluator(
+        {"type": "seq_text_printer", "input": "out", "printer": pr}
+    )
+    ev.add_batch({}, {"out": id_arg(np.array([[4, 5, 6]]), seq_lens=[2])})
+    assert lines[-1] == "4 5"
+
+    ev = create_evaluator(
+        {"type": "max_frame_printer", "input": "out", "printer": pr}
+    )
+    v = np.zeros((1, 3, 2), np.float32)
+    v[0, 1, 0] = 9.0
+    ev.add_batch({}, {"out": seq(v, [3])})
+    assert lines[-1] == "[1]"
+
+    ev = create_evaluator(
+        {"type": "gradient_printer", "input": "out", "printer": pr}
+    )
+    ev.add_batch({}, {"out": non_seq(p)})
+    assert "no grad tap" in lines[-1]
+    ev.add_batch({"out@GRAD": non_seq(p)}, {"out": non_seq(p)})
+    assert "no grad tap" not in lines[-1]
