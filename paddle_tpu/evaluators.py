@@ -361,13 +361,21 @@ def _edit_distance(ref, hyp):
         return m, 0, 0, m
     if m == 0:
         return n, 0, n, 0
+    ref_a = np.asarray(ref)
+    hyp_a = np.asarray(hyp)
     d = np.zeros((n + 1, m + 1), np.int64)
     d[:, 0] = np.arange(n + 1)
     d[0, :] = np.arange(m + 1)
+    # vectorized per row; the insertion prefix dependency
+    # r[j] = min(best[j], r[j-1]+1) solved with the minimum.accumulate
+    # trick on s[j] = r[j] - j
+    col = np.arange(1, m + 1)
     for i in range(1, n + 1):
-        for j in range(1, m + 1):
-            c = 0 if ref[i - 1] == hyp[j - 1] else 1
-            d[i, j] = min(d[i - 1, j - 1] + c, d[i - 1, j] + 1, d[i, j - 1] + 1)
+        cost = (hyp_a != ref_a[i - 1]).astype(np.int64)
+        best = np.minimum(d[i - 1, :-1] + cost, d[i - 1, 1:] + 1)
+        s = np.minimum.accumulate(np.concatenate(([i], best - col)))
+        d[i, 1:] = s[1:] + col
+        d[i, 0] = i
     subs = dels = ins = 0
     i, j = n, m
     while i and j:
@@ -447,23 +455,27 @@ class _PrinterBase(Evaluator):
     or a user-supplied `printer` callable / `result_file` in conf)."""
 
     def start(self):
-        self.lines = []
+        self._fh = None
 
     def emit(self, line: str):
-        self.lines.append(line)
+        # stream to the result file (no unbounded in-memory accumulation)
+        path = self.conf.get("result_file")
+        if path:
+            if self._fh is None:
+                self._fh = open(path, "a")
+            self._fh.write(line + "\n")
         f = self.conf.get("printer")
         if f is not None:
             f(line)
-        else:
+        elif not path:
             import logging
 
             logging.getLogger("paddle_tpu.eval").info("%s: %s", self.name, line)
 
     def result(self):
-        path = self.conf.get("result_file")
-        if path:
-            with open(path, "a") as fh:
-                fh.write("\n".join(self.lines) + "\n")
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
         return None
 
 

@@ -1,0 +1,34 @@
+"""The op framework — imperative op graphs over Scopes.
+
+Capability equivalent of the reference's embryonic "framework" rewrite
+(SURVEY.md §2 rows 25-26): Variable/Scope (framework/variable.h:24,
+framework/scope.h:36), OperatorBase + registry (framework/operator.h:63,
+framework/op_registry.h), autodiff by op-level transposition
+(framework/backward.cc:65-109), composite NetOp (operators/net_op.h) and
+the dynamic RecurrentOp with per-step scopes (operators/recurrent_op.h:44).
+
+TPU-first divergence: ops carry pure jax.numpy kernels, so the same graph
+runs eagerly op-by-op (the reference's Run(scope, dev_ctx) mode) or is
+traced once by `net_to_fn` and jit-compiled into a single fused XLA
+program — the "operators on a compiler" endpoint the reference stack was
+heading toward.
+"""
+
+from paddle_tpu.framework.scope import Scope, Variable  # noqa: F401
+from paddle_tpu.framework.op import (  # noqa: F401
+    GRAD_SUFFIX,
+    NetOp,
+    OperatorBase,
+    create_op,
+    grad_op_for,
+    net_to_fn,
+    register_grad,
+    register_op,
+)
+from paddle_tpu.framework import ops  # noqa: F401
+from paddle_tpu.framework.backward import backward  # noqa: F401
+from paddle_tpu.framework.recurrent import (  # noqa: F401
+    MemoryAttr,
+    RecurrentGradientOp,
+    RecurrentOp,
+)

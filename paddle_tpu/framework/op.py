@@ -1,0 +1,194 @@
+"""OperatorBase, op registry, grad-op builders, NetOp, and the jit bridge.
+
+Reference: framework/operator.h:63 (OperatorBase: type + named input/output
+var lists + attrs, Run(scope, ctx)), framework/op_registry.h (registration
++ CreateOp), framework/grad_op_builder.cc (forward op -> grad op with
+I/O wired by @GRAD-suffix convention), operators/net_op.h (composite op
+running children in order, CompleteAddOp output inference).
+
+TPU-first divergence: a kernel is a pure function of jax arrays; `run`
+executes it eagerly (numpy/jax interop), while `net_to_fn` closes a whole
+net over a feed list and returns a jittable pure function — XLA then fuses
+across op boundaries, which is the role the reference's per-op CUDA
+kernels + planned executor could never fill.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from paddle_tpu.framework.scope import Scope
+
+GRAD_SUFFIX = "@GRAD"  # framework: kGradVarSuffix
+EMPTY_VAR = "@EMPTY@"  # framework: kEmptyVarName
+
+VarMap = Dict[str, List[str]]
+
+_OPS: Dict[str, type] = {}
+_GRAD_BUILDERS: Dict[str, Callable] = {}
+
+
+def register_op(name: str):
+    def deco(cls):
+        cls.type = name
+        _OPS[name] = cls
+        return cls
+
+    return deco
+
+
+def register_grad(name: str):
+    """Register fn(fwd_op) -> list[OperatorBase] building the grad op(s)."""
+
+    def deco(fn):
+        _GRAD_BUILDERS[name] = fn
+        return fn
+
+    return deco
+
+
+def _as_varmap(m) -> VarMap:
+    out: VarMap = {}
+    for k, v in (m or {}).items():
+        out[k] = [v] if isinstance(v, str) else list(v)
+    return out
+
+
+class OperatorBase:
+    """type + named input/output variable lists + attrs
+    (framework/operator.h:63,90)."""
+
+    type: str = "base"
+
+    def __init__(self, inputs=None, outputs=None, attrs=None):
+        self.inputs: VarMap = _as_varmap(inputs)
+        self.outputs: VarMap = _as_varmap(outputs)
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+
+    # -- slot helpers (operator.h Input/Inputs/Output) --
+    def input(self, slot: str) -> str:
+        names = self.inputs[slot]
+        assert len(names) == 1, f"{self.type}.{slot} is a list slot"
+        return names[0]
+
+    def output(self, slot: str) -> str:
+        names = self.outputs[slot]
+        assert len(names) == 1, f"{self.type}.{slot} is a list slot"
+        return names[0]
+
+    def input_vars(self) -> List[str]:
+        return [n for ns in self.inputs.values() for n in ns]
+
+    def output_vars(self) -> List[str]:
+        return [n for ns in self.outputs.values() for n in ns]
+
+    # -- execution --
+    def kernel(self, ins: Dict[str, Any], attrs: Dict[str, Any]):
+        """Pure function: slot->array(s) in, slot->array(s) out."""
+        raise NotImplementedError(self.type)
+
+    def run(self, scope: Scope) -> None:
+        ins = {}
+        for slot, names in self.inputs.items():
+            vals = [
+                None if n == EMPTY_VAR else scope.get(n) for n in names
+            ]
+            ins[slot] = vals[0] if len(vals) == 1 else vals
+        outs = self.kernel(ins, self.attrs)
+        for slot, names in self.outputs.items():
+            vals = outs[slot]
+            if len(names) == 1:
+                vals = [vals]
+            for n, v in zip(names, vals):
+                if n != EMPTY_VAR:
+                    scope.set(n, v)
+
+    def __repr__(self):
+        return (
+            f"Op({self.type}, inputs={self.inputs}, "
+            f"outputs={self.outputs})"
+        )
+
+
+def create_op(type_name: str, inputs=None, outputs=None, attrs=None):
+    """OpRegistry::CreateOp (framework/op_registry.h)."""
+    if type_name not in _OPS:
+        known = ", ".join(sorted(_OPS))
+        raise KeyError(f"unknown op type {type_name!r}; registered: {known}")
+    return _OPS[type_name](inputs=inputs, outputs=outputs, attrs=attrs)
+
+
+def grad_op_for(op: OperatorBase) -> List[OperatorBase]:
+    """Build the grad op(s) of a forward op
+    (framework/grad_op_builder.cc)."""
+    if op.type not in _GRAD_BUILDERS:
+        raise KeyError(f"op {op.type!r} has no registered grad builder")
+    ops = _GRAD_BUILDERS[op.type](op)
+    return ops if isinstance(ops, list) else [ops]
+
+
+class NetOp(OperatorBase):
+    """Composite op: children run in insertion order
+    (operators/net_op.h)."""
+
+    type = "net"
+
+    def __init__(self, inputs=None, outputs=None, attrs=None):
+        super().__init__(inputs, outputs, attrs)
+        self.ops: List[OperatorBase] = []
+
+    def append_op(self, op: OperatorBase) -> OperatorBase:
+        self.ops.append(op)
+        return op
+
+    def add_op(self, type_name, inputs=None, outputs=None, attrs=None):
+        return self.append_op(create_op(type_name, inputs, outputs, attrs))
+
+    def complete_add_op(self) -> None:
+        """Infer net-level inputs (consumed before produced) and outputs
+        (produced by any child) — net_op.h CompleteAddOp."""
+        produced, needed = set(), []
+        outs = []
+        for op in self.ops:
+            for n in op.input_vars():
+                if n not in produced and n != EMPTY_VAR:
+                    needed.append(n)
+            for n in op.output_vars():
+                if n != EMPTY_VAR:
+                    produced.add(n)
+                    outs.append(n)
+        seen = set()
+        self.inputs = {
+            "X": [n for n in needed if not (n in seen or seen.add(n))]
+        }
+        seen = set()
+        self.outputs = {
+            "Out": [n for n in outs if not (n in seen or seen.add(n))]
+        }
+
+    def run(self, scope: Scope) -> None:
+        for op in self.ops:
+            op.run(scope)
+
+
+def net_to_fn(
+    net: OperatorBase,
+    feed_names: Sequence[str],
+    fetch_names: Sequence[str],
+    const_scope: Optional[Scope] = None,
+) -> Callable:
+    """Close a net over (feeds -> fetches) as a pure function.
+
+    jax.jit(net_to_fn(net, ...)) compiles the whole op graph into one XLA
+    program. `const_scope` supplies non-differentiated constants visible
+    via parent lookup.
+    """
+
+    def fn(*feed_values):
+        scope = Scope(parent=const_scope)
+        for name, val in zip(feed_names, feed_values):
+            scope.set(name, val)
+        net.run(scope)
+        return tuple(scope.get(n) for n in fetch_names)
+
+    return fn

@@ -1,0 +1,365 @@
+"""The new-style op set with registered grad-op builders.
+
+Reference: paddle/operators/*.cc — add, mul, mean, sigmoid, softmax,
+onehot cross_entropy, rowwise_add, sgd, fill_zeros_like, gaussian_random,
+uniform_random (35 REGISTER_OP* registrations total), gather/scatter
+kernels (operators/gather.h, operators/scatter.h). Kernels here are pure
+jax.numpy; each forward op registers a grad builder wiring @GRAD-suffixed
+variables exactly like framework/grad_op_builder.cc.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.op import (
+    GRAD_SUFFIX as G,
+    OperatorBase,
+    create_op,
+    register_grad,
+    register_op,
+)
+
+
+def _g(name: str) -> str:
+    return name + G
+
+
+# ---------------------------------------------------------------- add
+@register_op("add")
+class AddOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"] + ins["Y"]}
+
+
+@register_grad("add")
+def _add_grad(op):
+    x, y, out = op.input("X"), op.input("Y"), op.output("Out")
+    return [
+        create_op("identity", {"X": _g(out)}, {"Out": _g(x)}),
+        create_op(
+            "reduce_to_shape_of",
+            {"X": _g(out), "Like": y},
+            {"Out": _g(y)},
+        ),
+    ]
+
+
+@register_op("identity")
+class IdentityOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"]}
+
+
+@register_grad("identity")
+def _identity_grad(op):
+    return [
+        create_op(
+            "identity",
+            {"X": _g(op.output("Out"))},
+            {"Out": _g(op.input("X"))},
+        )
+    ]
+
+
+@register_op("reduce_to_shape_of")
+class ReduceToShapeOfOp(OperatorBase):
+    """Sum-reduce X over broadcast dims so it matches Like's shape
+    (the unbroadcast needed by add/rowwise_add grads)."""
+
+    def kernel(self, ins, attrs):
+        x, like = ins["X"], ins["Like"]
+        extra = x.ndim - like.ndim
+        if extra:
+            x = x.sum(axis=tuple(range(extra)))
+        keep = tuple(
+            i for i, (a, b) in enumerate(zip(x.shape, like.shape)) if a != b
+        )
+        if keep:
+            x = x.sum(axis=keep, keepdims=True)
+        return {"Out": x.reshape(like.shape)}
+
+
+# ---------------------------------------------------------------- sum
+@register_op("sum")
+class SumOp(OperatorBase):
+    """Accumulates a list of same-shape inputs; inserted by backward()
+    for fan-out gradient accumulation (framework/backward.cc:117-140
+    add op over @RENAME@ duplicates)."""
+
+    def kernel(self, ins, attrs):
+        xs = ins["X"]
+        if not isinstance(xs, list):
+            xs = [xs]
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return {"Out": out}
+
+
+# ---------------------------------------------------------------- mul
+@register_op("mul")
+class MulOp(OperatorBase):
+    """Matrix multiply (operators/mul_op.cc)."""
+
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"] @ ins["Y"]}
+
+
+@register_grad("mul")
+def _mul_grad(op):
+    x, y, out = op.input("X"), op.input("Y"), op.output("Out")
+    return [
+        create_op("matmul_nt", {"X": _g(out), "Y": y}, {"Out": _g(x)}),
+        create_op("matmul_tn", {"X": x, "Y": _g(out)}, {"Out": _g(y)}),
+    ]
+
+
+@register_op("matmul_nt")
+class MatmulNTOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"] @ ins["Y"].T}
+
+
+@register_op("matmul_tn")
+class MatmulTNOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"].T @ ins["Y"]}
+
+
+# ---------------------------------------------------------------- mean
+@register_op("mean")
+class MeanOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": jnp.mean(ins["X"])}
+
+
+@register_grad("mean")
+def _mean_grad(op):
+    x, out = op.input("X"), op.output("Out")
+    return [
+        create_op("mean_grad", {"X": x, "Out@G": _g(out)}, {"Out": _g(x)})
+    ]
+
+
+@register_op("mean_grad")
+class MeanGradOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        x = ins["X"]
+        return {"Out": jnp.broadcast_to(ins["Out@G"] / x.size, x.shape)}
+
+
+# ---------------------------------------------------------------- scale
+@register_op("scale")
+class ScaleOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"] * attrs.get("scale", 1.0)}
+
+
+@register_grad("scale")
+def _scale_grad(op):
+    return [
+        create_op(
+            "scale",
+            {"X": _g(op.output("Out"))},
+            {"Out": _g(op.input("X"))},
+            {"scale": op.attrs.get("scale", 1.0)},
+        )
+    ]
+
+
+# ---------------------------------------------------------------- sigmoid
+@register_op("sigmoid")
+class SigmoidOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Y": jax.nn.sigmoid(ins["X"])}
+
+
+@register_grad("sigmoid")
+def _sigmoid_grad(op):
+    y = op.output("Y")
+    return [
+        create_op(
+            "sigmoid_grad",
+            {"Y": y, "Y@G": _g(y)},
+            {"Out": _g(op.input("X"))},
+        )
+    ]
+
+
+@register_op("sigmoid_grad")
+class SigmoidGradOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        y = ins["Y"]
+        return {"Out": ins["Y@G"] * y * (1.0 - y)}
+
+
+# ---------------------------------------------------------------- softmax
+@register_op("softmax")
+class SoftmaxOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Y": jax.nn.softmax(ins["X"], axis=-1)}
+
+
+@register_grad("softmax")
+def _softmax_grad(op):
+    y = op.output("Y")
+    return [
+        create_op(
+            "softmax_grad",
+            {"Y": y, "Y@G": _g(y)},
+            {"Out": _g(op.input("X"))},
+        )
+    ]
+
+
+@register_op("softmax_grad")
+class SoftmaxGradOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        y, dy = ins["Y"], ins["Y@G"]
+        return {"Out": y * (dy - jnp.sum(dy * y, axis=-1, keepdims=True))}
+
+
+# ------------------------------------------------------- cross entropy
+@register_op("onehot_cross_entropy")
+class OnehotCrossEntropyOp(OperatorBase):
+    """Y_i = -log(X[i, label_i]) (operators/cross_entropy_op.cc)."""
+
+    def kernel(self, ins, attrs):
+        x, label = ins["X"], ins["label"]
+        picked = jnp.take_along_axis(x, label[:, None], axis=1)[:, 0]
+        return {"Y": -jnp.log(jnp.maximum(picked, 1e-20))}
+
+
+@register_grad("onehot_cross_entropy")
+def _xent_grad(op):
+    x, label, y = op.input("X"), op.input("label"), op.output("Y")
+    return [
+        create_op(
+            "onehot_cross_entropy_grad",
+            {"X": x, "label": label, "Y@G": _g(y)},
+            {"Out": _g(x)},
+        )
+    ]
+
+
+@register_op("onehot_cross_entropy_grad")
+class OnehotCrossEntropyGradOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        x, label, dy = ins["X"], ins["label"], ins["Y@G"]
+        onehot = jax.nn.one_hot(label, x.shape[1], dtype=x.dtype)
+        return {"Out": -onehot * (dy[:, None] / jnp.maximum(x, 1e-20))}
+
+
+# ------------------------------------------------------- rowwise add
+@register_op("rowwise_add")
+class RowwiseAddOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Out": ins["X"] + ins["b"]}
+
+
+@register_grad("rowwise_add")
+def _rowwise_add_grad(op):
+    x, b, out = op.input("X"), op.input("b"), op.output("Out")
+    return [
+        create_op("identity", {"X": _g(out)}, {"Out": _g(x)}),
+        create_op(
+            "reduce_to_shape_of", {"X": _g(out), "Like": b}, {"Out": _g(b)}
+        ),
+    ]
+
+
+# ---------------------------------------------------------------- sgd
+@register_op("sgd")
+class SGDOp(OperatorBase):
+    """param_out = param - lr * grad (operators/sgd_op.cc)."""
+
+    def kernel(self, ins, attrs):
+        lr = attrs.get("learning_rate", 0.01)
+        return {"param_out": ins["param"] - lr * ins["grad"]}
+
+
+# ------------------------------------------------------ fill zeros like
+@register_op("fill_zeros_like")
+class FillZerosLikeOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        return {"Dst": jnp.zeros_like(ins["Src"])}
+
+
+# ------------------------------------------------------- random ops
+@register_op("gaussian_random")
+class GaussianRandomOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        key = jax.random.key(attrs.get("seed", 0))
+        shape = tuple(attrs["dims"])
+        return {
+            "Out": attrs.get("mean", 0.0)
+            + attrs.get("std", 1.0)
+            * jax.random.normal(key, shape, dtype=jnp.float32)
+        }
+
+
+@register_op("uniform_random")
+class UniformRandomOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        key = jax.random.key(attrs.get("seed", 0))
+        shape = tuple(attrs["dims"])
+        return {
+            "Out": jax.random.uniform(
+                key,
+                shape,
+                minval=attrs.get("min", -1.0),
+                maxval=attrs.get("max", 1.0),
+                dtype=jnp.float32,
+            )
+        }
+
+
+# ------------------------------------------------------- gather/scatter
+@register_op("gather")
+class GatherOp(OperatorBase):
+    """Out = X[Index] rows (operators/gather.h)."""
+
+    def kernel(self, ins, attrs):
+        return {"Out": jnp.take(ins["X"], ins["Index"], axis=0)}
+
+
+@register_grad("gather")
+def _gather_grad(op):
+    x, idx, out = op.input("X"), op.input("Index"), op.output("Out")
+    return [
+        create_op(
+            "scatter_add_like",
+            {"Like": x, "Index": idx, "Updates": _g(out)},
+            {"Out": _g(x)},
+        )
+    ]
+
+
+@register_op("scatter_add_like")
+class ScatterAddLikeOp(OperatorBase):
+    def kernel(self, ins, attrs):
+        zeros = jnp.zeros_like(ins["Like"])
+        return {"Out": zeros.at[ins["Index"]].add(ins["Updates"])}
+
+
+@register_op("scatter")
+class ScatterOp(OperatorBase):
+    """Out = Ref with Updates added at Index rows
+    (operators/scatter.h ScatterUpdate)."""
+
+    def kernel(self, ins, attrs):
+        return {"Out": ins["Ref"].at[ins["Index"]].add(ins["Updates"])}
+
+
+@register_grad("scatter")
+def _scatter_grad(op):
+    ref, idx, upd = op.input("Ref"), op.input("Index"), op.input("Updates")
+    out = op.output("Out")
+    return [
+        create_op("identity", {"X": _g(out)}, {"Out": _g(ref)}),
+        create_op(
+            "gather", {"X": _g(out), "Index": idx}, {"Out": _g(upd)}
+        ),
+    ]

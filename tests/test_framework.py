@@ -1,0 +1,406 @@
+"""Tests for the new op framework (paddle_tpu/framework/).
+
+Mirrors the reference's framework tests: backward_test.cc (transposition
+structure, no-grad, fan-out accumulation), op_registry_test.cc,
+scope_test.cc, and python/paddle/v2/framework/tests/gradient_checker.py
+(numeric vs backward-net gradients), plus recurrent_op semantics
+(operators/recurrent_op.h) checked eager-vs-lax.scan and against
+jax.grad.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.framework import (
+    GRAD_SUFFIX as G,
+    MemoryAttr,
+    NetOp,
+    RecurrentOp,
+    Scope,
+    backward,
+    create_op,
+    net_to_fn,
+)
+
+
+def _mlp_net():
+    """x@w + b -> sigmoid -> softmax -> xent(label) -> mean."""
+    net = NetOp()
+    net.add_op("mul", {"X": "x", "Y": "w"}, {"Out": "xw"})
+    net.add_op("rowwise_add", {"X": "xw", "b": "b"}, {"Out": "z"})
+    net.add_op("sigmoid", {"X": "z"}, {"Y": "h"})
+    net.add_op("softmax", {"X": "h"}, {"Y": "p"})
+    net.add_op(
+        "onehot_cross_entropy", {"X": "p", "label": "label"}, {"Y": "ce"}
+    )
+    net.add_op("mean", {"X": "ce"}, {"Out": "loss"})
+    net.complete_add_op()
+    return net
+
+
+def _feed(scope, rng):
+    vals = {
+        "x": jnp.asarray(rng.standard_normal((4, 3)), jnp.float32),
+        "w": jnp.asarray(rng.standard_normal((3, 5)), jnp.float32),
+        "b": jnp.asarray(rng.standard_normal(5), jnp.float32),
+        "label": jnp.asarray([0, 2, 4, 1], jnp.int32),
+    }
+    for k, v in vals.items():
+        scope.set(k, v)
+    return vals
+
+
+class TestScope:
+    def test_hierarchy(self):
+        root = Scope()
+        root.set("a", 1)
+        kid = root.new_scope()
+        assert kid.get("a") == 1  # parent lookup (scope.h:52-59)
+        kid.set("a", 2)
+        assert kid.get("a") == 2 and root.get("a") == 1  # shadowing
+        assert "missing" not in kid
+        with pytest.raises(KeyError):
+            kid.get("missing")
+
+
+class TestOps:
+    def test_unknown_op(self):
+        with pytest.raises(KeyError):
+            create_op("nope", {}, {})
+
+    def test_eager_forward(self):
+        scope = Scope()
+        _feed(scope, np.random.default_rng(0))
+        _mlp_net().run(scope)
+        loss = scope.get("loss")
+        assert loss.shape == () and np.isfinite(float(loss))
+
+    def test_random_ops_deterministic(self):
+        s = Scope()
+        for t in ("gaussian_random", "uniform_random"):
+            create_op(t, {}, {"Out": "r"}, {"dims": [2, 3], "seed": 7}).run(s)
+            a = np.asarray(s.get("r"))
+            create_op(t, {}, {"Out": "r"}, {"dims": [2, 3], "seed": 7}).run(s)
+            assert np.array_equal(a, np.asarray(s.get("r")))
+
+    def test_sgd(self):
+        s = Scope()
+        s.set("p", jnp.ones(4))
+        s.set("g", jnp.full(4, 2.0))
+        create_op(
+            "sgd",
+            {"param": "p", "grad": "g"},
+            {"param_out": "p"},
+            {"learning_rate": 0.5},
+        ).run(s)
+        np.testing.assert_allclose(np.asarray(s.get("p")), 0.0)
+
+
+class TestBackward:
+    def test_grads_match_jax_grad(self):
+        net = _mlp_net()
+        scope = Scope()
+        vals = _feed(scope, np.random.default_rng(1))
+        net.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(net, seeded={"loss"}).run(scope)
+
+        def loss_fn(x, w, b):
+            fn = net_to_fn(net, ["x", "w", "b", "label"], ["loss"])
+            return fn(x, w, b, vals["label"])[0]
+
+        ref = jax.grad(loss_fn, argnums=(0, 1, 2))(
+            vals["x"], vals["w"], vals["b"]
+        )
+        for name, r in zip(("x", "w", "b"), ref):
+            np.testing.assert_allclose(
+                np.asarray(scope.get(name + G)),
+                np.asarray(r),
+                rtol=1e-4,
+                atol=1e-5,
+            )
+
+    def test_numeric_gradient(self):
+        # gradient_checker.py analogue: central differences on the loss
+        net = _mlp_net()
+        rng = np.random.default_rng(2)
+        scope = Scope()
+        vals = _feed(scope, rng)
+        net.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(net, seeded={"loss"}).run(scope)
+        fn = net_to_fn(net, ["x", "w", "b", "label"], ["loss"])
+        b = np.asarray(vals["b"], np.float64)
+        eps = 1e-3
+        num = np.zeros_like(b)
+        for i in range(b.size):
+            hi, lo = b.copy(), b.copy()
+            hi[i] += eps
+            lo[i] -= eps
+            num[i] = (
+                float(
+                    fn(vals["x"], vals["w"], jnp.asarray(hi, jnp.float32),
+                       vals["label"])[0]
+                )
+                - float(
+                    fn(vals["x"], vals["w"], jnp.asarray(lo, jnp.float32),
+                       vals["label"])[0]
+                )
+            ) / (2 * eps)
+        np.testing.assert_allclose(
+            np.asarray(scope.get("b" + G)), num, rtol=2e-2, atol=1e-4
+        )
+
+    def test_fanout_accumulation(self):
+        # x feeds two consumers -> dx is the sum of both paths
+        # (backward.cc:117-140 rename + add)
+        net = NetOp()
+        net.add_op("sigmoid", {"X": "x"}, {"Y": "a"})
+        net.add_op("scale", {"X": "x"}, {"Out": "b"}, {"scale": 3.0})
+        net.add_op("add", {"X": "a", "Y": "b"}, {"Out": "s"})
+        net.add_op("mean", {"X": "s"}, {"Out": "loss"})
+        net.complete_add_op()
+        scope = Scope()
+        x = jnp.asarray(np.random.default_rng(3).standard_normal(6),
+                        jnp.float32)
+        scope.set("x", x)
+        net.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(net, seeded={"loss"}).run(scope)
+        ref = jax.grad(
+            lambda x: net_to_fn(net, ["x"], ["loss"])(x)[0]
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(scope.get("x" + G)), np.asarray(ref), rtol=1e-5
+        )
+
+    def test_no_grad(self):
+        net = _mlp_net()
+        scope = Scope()
+        _feed(scope, np.random.default_rng(4))
+        net.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(net, no_grad={"x"}, seeded={"loss"}).run(scope)
+        assert scope.find_var("x" + G) is None or scope.get("x" + G) is None
+        assert scope.get("w" + G) is not None
+
+    def test_unused_output_gets_zero_seed(self):
+        net = NetOp()
+        net.add_op("sigmoid", {"X": "x"}, {"Y": "h"})
+        net.add_op("sigmoid", {"X": "h"}, {"Y": "unused"})
+        net.add_op("mean", {"X": "h"}, {"Out": "loss"})
+        net.complete_add_op()
+        scope = Scope()
+        x = jnp.asarray([0.5, -0.5], jnp.float32)
+        scope.set("x", x)
+        net.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(net, seeded={"loss"}).run(scope)
+        ref = jax.grad(
+            lambda x: net_to_fn(net, ["x"], ["loss"])(x)[0]
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(scope.get("x" + G)), np.asarray(ref), rtol=1e-5
+        )
+
+    def test_gather_scatter_grads(self):
+        net = NetOp()
+        net.add_op("gather", {"X": "tbl", "Index": "idx"}, {"Out": "rows"})
+        net.add_op("mean", {"X": "rows"}, {"Out": "loss"})
+        net.complete_add_op()
+        scope = Scope()
+        tbl = jnp.asarray(np.arange(12, dtype=np.float32).reshape(4, 3))
+        idx = jnp.asarray([1, 1, 3], jnp.int32)
+        scope.set("tbl", tbl)
+        scope.set("idx", idx)
+        net.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(net, seeded={"loss"}).run(scope)
+        dtbl = np.asarray(scope.get("tbl" + G))
+        assert dtbl[1].sum() > 0 and dtbl[0].sum() == 0  # scatter-add
+        np.testing.assert_allclose(dtbl[1], 2.0 / 9.0, rtol=1e-5)
+
+
+class TestJit:
+    def test_net_compiles_to_one_program(self):
+        net = _mlp_net()
+        vals = _feed(Scope(), np.random.default_rng(5))
+        fn = jax.jit(net_to_fn(net, ["x", "w", "b", "label"], ["loss", "p"]))
+        loss, p = fn(vals["x"], vals["w"], vals["b"], vals["label"])
+        assert np.isfinite(float(loss)) and p.shape == (4, 5)
+
+
+class TestRecurrentOp:
+    def _build(self):
+        # h_t = sigmoid(x_t @ W + h_{t-1} @ U)
+        step = NetOp()
+        step.add_op("mul", {"X": "x", "Y": "W"}, {"Out": "xw"})
+        step.add_op("mul", {"X": "h_pre", "Y": "U"}, {"Out": "hu"})
+        step.add_op("add", {"X": "xw", "Y": "hu"}, {"Out": "z"})
+        step.add_op("sigmoid", {"X": "z"}, {"Y": "h"})
+        step.complete_add_op()
+        return RecurrentOp(
+            stepnet=step,
+            inlinks=["x"],
+            outlinks=["h"],
+            memories=[MemoryAttr(var="h", pre_var="h_pre", boot_var="h0")],
+        )
+
+    def _vals(self):
+        rng = np.random.default_rng(6)
+        return {
+            "x": jnp.asarray(rng.standard_normal((5, 2, 3)), jnp.float32),
+            "W": jnp.asarray(rng.standard_normal((3, 4)), jnp.float32),
+            "U": jnp.asarray(rng.standard_normal((4, 4)), jnp.float32),
+            "h0": jnp.zeros((2, 4), jnp.float32),
+        }
+
+    def test_eager_matches_scan(self):
+        op = self._build()
+        vals = self._vals()
+        scope = Scope()
+        for k, v in vals.items():
+            scope.set(k, v)
+        op.run(scope)
+        eager = np.asarray(scope.get("h"))
+        assert eager.shape == (5, 2, 4)
+        ext = op.extern_names()
+        assert set(ext) == {"W", "U"}
+        scan = op.scan_fn(ext)
+        (h_seq,) = jax.jit(scan)(
+            [vals[n] for n in ext], [vals["h0"]], [vals["x"]]
+        )
+        np.testing.assert_allclose(eager, np.asarray(h_seq), rtol=1e-5)
+
+    def test_recurrent_backward_matches_jax_grad(self):
+        op = self._build()
+        vals = self._vals()
+        scope = Scope()
+        for k, v in vals.items():
+            scope.set(k, v)
+        op.run(scope)
+        dh = jnp.ones_like(scope.get("h"))
+        scope.set("h" + G, dh)
+        op.build_grad_op().run(scope)
+
+        ext = op.extern_names()
+        scan = op.scan_fn(ext)
+
+        def total(W, U, h0, x):
+            (h_seq,) = scan([W, U], [h0], [x])
+            return jnp.sum(h_seq)
+
+        ref = jax.grad(total, argnums=(0, 1, 2, 3))(
+            vals["W"], vals["U"], vals["h0"], vals["x"]
+        )
+        for name, r in zip(("W", "U", "h0", "x"), ref):
+            np.testing.assert_allclose(
+                np.asarray(scope.get(name + G)),
+                np.asarray(r),
+                rtol=1e-4,
+                atol=1e-5,
+                err_msg=name,
+            )
+
+    def test_shared_weight_stepnet_and_outer_op(self):
+        # W feeds both the recurrent stepnet and an outer consumer: the
+        # recurrent grad op must participate in fan-out accumulation
+        op = self._build()
+        vals = self._vals()
+        outer = NetOp()
+        outer.append_op(op)
+        outer.add_op("mean", {"X": "h"}, {"Out": "mh"})
+        outer.add_op("mean", {"X": "W"}, {"Out": "mw"})
+        outer.add_op("add", {"X": "mh", "Y": "mw"}, {"Out": "loss"})
+        outer.complete_add_op()
+        scope = Scope()
+        for k, v in vals.items():
+            scope.set(k, v)
+        outer.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(outer, seeded={"loss"}).run(scope)
+
+        ext = op.extern_names()
+        scan = op.scan_fn(ext)
+
+        def loss_fn(W, U):
+            (h_seq,) = scan([W, U], [vals["h0"]], [vals["x"]])
+            return jnp.mean(h_seq) + jnp.mean(W)
+
+        ref = jax.grad(loss_fn, argnums=(0, 1))(vals["W"], vals["U"])
+        for name, r in zip(("W", "U"), ref):
+            np.testing.assert_allclose(
+                np.asarray(scope.get(name + G)),
+                np.asarray(r),
+                rtol=1e-4,
+                atol=1e-5,
+                err_msg=name,
+            )
+
+    def test_inlink_fanout_through_recurrent(self):
+        # x feeds both the RecurrentOp and an outer op; backward() renames
+        # the recurrent grad op's declared inlink-grad output and sums
+        op = self._build()
+        vals = self._vals()
+        outer = NetOp()
+        outer.append_op(op)
+        outer.add_op("mean", {"X": "h"}, {"Out": "mh"})
+        outer.add_op("mean", {"X": "x"}, {"Out": "mx"})
+        outer.add_op("add", {"X": "mh", "Y": "mx"}, {"Out": "loss"})
+        outer.complete_add_op()
+        scope = Scope()
+        for k, v in vals.items():
+            scope.set(k, v)
+        outer.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(outer, seeded={"loss"}).run(scope)
+
+        ext = op.extern_names()
+        scan = op.scan_fn(ext)
+
+        def loss_fn(x):
+            (h_seq,) = scan(
+                [vals["W"], vals["U"]], [vals["h0"]], [x]
+            )
+            return jnp.mean(h_seq) + jnp.mean(x)
+
+        ref = jax.grad(loss_fn)(vals["x"])
+        np.testing.assert_allclose(
+            np.asarray(scope.get("x" + G)),
+            np.asarray(ref),
+            rtol=1e-4,
+            atol=1e-5,
+        )
+
+    def test_backward_of_net_containing_recurrent(self):
+        op = self._build()
+        vals = self._vals()
+        outer = NetOp()
+        outer.append_op(op)
+        outer.add_op("mean", {"X": "h"}, {"Out": "loss"})
+        outer.complete_add_op()
+        scope = Scope()
+        for k, v in vals.items():
+            scope.set(k, v)
+        outer.run(scope)
+        scope.set("loss" + G, jnp.float32(1.0))
+        backward(outer, seeded={"loss"}).run(scope)
+
+        ext = op.extern_names()
+        scan = op.scan_fn(ext)
+
+        def loss_fn(W, U):
+            (h_seq,) = scan([W, U], [vals["h0"]], [vals["x"]])
+            return jnp.mean(h_seq)
+
+        ref = jax.grad(loss_fn, argnums=(0, 1))(vals["W"], vals["U"])
+        for name, r in zip(("W", "U"), ref):
+            np.testing.assert_allclose(
+                np.asarray(scope.get(name + G)),
+                np.asarray(r),
+                rtol=1e-4,
+                atol=1e-5,
+                err_msg=name,
+            )
