@@ -396,6 +396,55 @@ def rank_cost(a, b, label, name=None, coeff=1.0):
                 coeff=coeff)
 
 
+def multibox_loss(priorbox_ref, gt_box, gt_label, loc_pred, conf_pred,
+                  num_classes, name=None, overlap_threshold=0.5,
+                  neg_pos_ratio=3.0, neg_overlap=0.5, background_id=0):
+    """(trainer_config_helpers/layers.py multibox_loss_layer; gserver
+    MultiBoxLossLayer.cpp). loc_pred/conf_pred may be lists of per-scale
+    feature outputs — they are concatenated like the reference's
+    multi-input wiring."""
+    if isinstance(loc_pred, (tuple, list)):
+        loc_pred = concat(*loc_pred)
+    if isinstance(conf_pred, (tuple, list)):
+        conf_pred = concat(*conf_pred)
+    return _add("multibox_loss",
+                [priorbox_ref, gt_box, gt_label, loc_pred, conf_pred],
+                name=name, bias=False,
+                num_classes=num_classes,
+                overlap_threshold=overlap_threshold,
+                neg_pos_ratio=neg_pos_ratio, neg_overlap=neg_overlap,
+                background_id=background_id)
+
+
+# ---- detection (SSD) ----
+
+def priorbox(feature, image, min_size, max_size=(), aspect_ratio=(),
+             variance=(0.1, 0.1, 0.2, 0.2), flip=True, clip=True,
+             name=None):
+    """(layers.py priorbox_layer; gserver PriorBox.cpp)."""
+    return _add("priorbox", [feature, image], name=name, bias=False,
+                min_size=tuple(min_size), max_size=tuple(max_size),
+                aspect_ratio=tuple(aspect_ratio), variance=tuple(variance),
+                flip=flip, clip=clip)
+
+
+def detection_output(priorbox_ref, loc_pred, conf_pred, num_classes,
+                     name=None, nms_threshold=0.45, nms_top_k=400,
+                     keep_top_k=200, confidence_threshold=0.01,
+                     background_id=0):
+    """(layers.py detection_output_layer; DetectionOutputLayer.cpp)."""
+    if isinstance(loc_pred, (tuple, list)):
+        loc_pred = concat(*loc_pred)
+    if isinstance(conf_pred, (tuple, list)):
+        conf_pred = concat(*conf_pred)
+    return _add("detection_output", [priorbox_ref, loc_pred, conf_pred],
+                name=name, bias=False, num_classes=num_classes,
+                nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+                keep_top_k=keep_top_k,
+                confidence_threshold=confidence_threshold,
+                background_id=background_id)
+
+
 # ---- prebuilt networks (trainer_config_helpers/networks.py) ----
 
 def simple_img_conv_pool(x, num_filters, filter_size, pool_size, pool_stride,

@@ -567,3 +567,100 @@ class ClassificationErrorPrinter(_PrinterBase):
 
 def create_evaluator(conf: dict) -> Evaluator:
     return EVALUATORS.get(conf["type"])(conf)
+
+
+@EVALUATORS.register("detection_map")
+class DetectionMAPEvaluator(Evaluator):
+    """Mean average precision for SSD detection
+    (gserver/evaluators/DetectionMAPEvaluator.cpp).
+
+    conf: input = detection_output layer name (rows [label, score, box4]
+    per image, score==0 padding), label = gt boxes Arg name ([B,G,4] with
+    seq_lens), label_ids = gt label Arg name ([B,G] ids); optional
+    overlap_threshold (0.5), ap_type "11point"|"integral",
+    background_id (0). Accumulates per-class (score, tp) pairs and
+    per-class gt counts on host; result() sweeps each class's detections
+    by descending score, greedy-matching each to an unused gt with
+    IoU > threshold (true positive) else false positive.
+    """
+
+    def start(self):
+        from collections import defaultdict
+
+        self.dets = defaultdict(list)  # cls -> [(score, tp)]
+        self.n_gt = defaultdict(int)  # cls -> count
+
+    @staticmethod
+    def _iou(box, boxes):
+        x1 = np.maximum(box[0], boxes[:, 0])
+        y1 = np.maximum(box[1], boxes[:, 1])
+        x2 = np.minimum(box[2], boxes[:, 2])
+        y2 = np.minimum(box[3], boxes[:, 3])
+        inter = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / np.maximum(a + b - inter, 1e-10)
+
+    def add_batch(self, outs, feed):
+        det = self._get(outs, feed, "input")
+        gt_box = self._get(outs, feed, "label")
+        gt_label = feed[self.conf["label_ids"]]
+        thr = self.conf.get("overlap_threshold", 0.5)
+        d = np.asarray(det.value)
+        d = d.reshape(d.shape[0], -1, 6)
+        boxes = np.asarray(gt_box.value)
+        labels = np.asarray(gt_label.ids)
+        lens = np.asarray(gt_box.seq_lens)
+        for b in range(d.shape[0]):
+            g_box = boxes[b, : lens[b]]
+            g_lab = labels[b, : lens[b]]
+            for c in np.unique(g_lab):
+                self.n_gt[int(c)] += int((g_lab == c).sum())
+            rows = d[b]
+            rows = rows[rows[:, 1] > 0]
+            used = np.zeros(len(g_box), bool)
+            for cls, score, *box in rows[np.argsort(-rows[:, 1])]:
+                # match to the overall best-overlap gt of this class; a
+                # duplicate detection of an already-claimed gt is a FALSE
+                # positive (DetectionMAPEvaluator.cpp), not re-matched
+                cand = np.where(g_lab == int(cls))[0]
+                tp = 0
+                if len(cand):
+                    ious = self._iou(np.asarray(box), g_box[cand])
+                    j = int(np.argmax(ious))
+                    if ious[j] > thr and not used[cand[j]]:
+                        used[cand[j]] = True
+                        tp = 1
+                self.dets[int(cls)].append((float(score), tp))
+
+    def result(self):
+        ap_type = self.conf.get("ap_type", "11point")
+        aps = []
+        for c, n in self.n_gt.items():
+            if n == 0:
+                continue
+            pairs = sorted(self.dets.get(c, []), reverse=True)
+            tp = np.cumsum([t for _, t in pairs]) if pairs else np.array([])
+            if len(tp) == 0:
+                aps.append(0.0)
+                continue
+            fp = np.arange(1, len(tp) + 1) - tp
+            rec = tp / n
+            prec = tp / np.maximum(tp + fp, 1e-10)
+            if ap_type == "11point":
+                ap = float(
+                    np.mean(
+                        [
+                            prec[rec >= t].max() if (rec >= t).any() else 0.0
+                            for t in np.linspace(0, 1, 11)
+                        ]
+                    )
+                )
+            else:  # integral
+                ap = float(
+                    np.sum(
+                        (rec - np.concatenate(([0.0], rec[:-1]))) * prec
+                    )
+                )
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

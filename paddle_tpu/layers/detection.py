@@ -1,0 +1,147 @@
+"""SSD detection layers: priorbox, multibox_loss, detection_output.
+
+Reference: gserver/layers/{PriorBox,MultiBoxLossLayer,DetectionOutputLayer}
+.cpp. Ground truth arrives as fixed-shape Args — boxes [B, G, 4] plus
+labels [B, G] ids with seq_lens giving the per-image ground-truth count —
+instead of the reference's variable-length label sequences; everything
+stays jittable (see ops/detection.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.layers.cost import CostLayerBase
+from paddle_tpu.ops import detection as D
+
+
+@LAYERS.register("priorbox")
+class PriorBoxLayer(Layer):
+    """inputs: [feature_map(conv, HWC dim), image(data, HWC dim)];
+    attrs: min_size, max_size, aspect_ratio, variance, flip, clip.
+    Output: [B, P*8] prior (box4, var4) rows — constant per shape, folded
+    by XLA (PriorBox.cpp:79)."""
+
+    def build(self, in_specs):
+        feat, img = in_specs
+        assert len(feat.dim) == 3, "priorbox needs an (H,W,C) feature map"
+        assert len(img.dim) == 3, "priorbox needs an (H,W,C) image input"
+        a = self.conf.attrs
+        self._priors = D.prior_boxes(
+            layer_hw=feat.dim[:2],
+            image_hw=img.dim[:2],
+            min_sizes=list(a.get("min_size", [])),
+            max_sizes=list(a.get("max_size", [])),
+            aspect_ratios=list(a.get("aspect_ratio", [])),
+            variances=list(a.get("variance", (0.1, 0.1, 0.2, 0.2))),
+            flip=a.get("flip", True),
+            clip=a.get("clip", True),
+        )
+        self.num_priors = self._priors.shape[0]
+        return Spec(dim=(self.num_priors * 8,)), {}
+
+    def forward(self, params, inputs, ctx):
+        b = inputs[0].batch
+        flat = jnp.asarray(self._priors.reshape(-1))
+        return Arg(value=jnp.broadcast_to(flat, (b, flat.shape[0])))
+
+
+def _split_priors(prior_arg: Arg):
+    pr = prior_arg.value[0].reshape(-1, 8)  # identical across batch
+    return pr[:, :4], pr[:, 4:]
+
+
+@LAYERS.register("multibox_loss")
+class MultiBoxLossLayer(CostLayerBase):
+    """inputs: [priorbox, label_boxes([B,G,4] seq), label_ids([B,G] ids
+    seq), loc_pred([B,P*4]), conf_pred([B,P*C])]; attrs: num_classes,
+    overlap_threshold, neg_pos_ratio, neg_overlap, background_id.
+
+    Per-batch cost matches MultiBoxLossLayer.cpp:207,259:
+    (smoothL1_sum + conf_ce_sum) / num_matches, computed fully on device.
+    """
+
+    def forward(self, params, inputs, ctx):
+        prior, gt_box, gt_label, loc, conf = inputs
+        a = self.conf.attrs
+        C = a["num_classes"]
+        priors, variances = _split_priors(prior)
+        P = priors.shape[0]
+        loc_pred = loc.value.reshape(-1, P, 4)
+        conf_pred = conf.value.reshape(-1, P, C)
+        boxes = gt_box.value  # [B, G, 4]
+        labels = gt_label.ids  # [B, G]
+        G = boxes.shape[1]
+        mask = (
+            jnp.arange(G)[None, :] < gt_box.seq_lens[:, None]
+        ).astype(jnp.float32)
+
+        def per_image(lp, cp, bx, lb, mk):
+            return D.multibox_loss(
+                lp,
+                cp,
+                priors,
+                variances,
+                bx,
+                lb,
+                mk,
+                overlap_threshold=a.get("overlap_threshold", 0.5),
+                neg_pos_ratio=a.get("neg_pos_ratio", 3.0),
+                neg_overlap=a.get("neg_overlap", 0.5),
+                background_id=a.get("background_id", 0),
+            )
+
+        loc_l, conf_l, n_pos = jax.vmap(per_image)(
+            loc_pred, conf_pred, boxes, labels, mask
+        )
+        denom = jnp.maximum(jnp.sum(n_pos).astype(jnp.float32), 1.0)
+        # loss_fn takes the batch MEAN of per-example costs; scale by B so
+        # the total equals (loc_sum + conf_sum) / num_matches exactly like
+        # locLoss_/confLoss_ in MultiBoxLossLayer.cpp:207,259
+        per_img = (loc_l + conf_l) * (loc_l.shape[0] / denom)
+        w = self.conf.attrs.get("coeff", 1.0)
+        return Arg(value=w * per_img)
+
+
+@LAYERS.register("detection_output")
+class DetectionOutputLayer(Layer):
+    """inputs: [priorbox, loc_pred, conf_pred]; attrs: num_classes,
+    nms_threshold, nms_top_k, keep_top_k, confidence_threshold,
+    background_id. Output [B, keep_top_k*6]; rows (label, score, box4),
+    score==0 marks padding (DetectionOutputLayer.cpp)."""
+
+    def build(self, in_specs):
+        a = self.conf.attrs
+        self._keep = a.get("keep_top_k", 200)
+        return Spec(dim=(self._keep * 6,)), {}
+
+    def forward(self, params, inputs, ctx):
+        prior, loc, conf = inputs
+        a = self.conf.attrs
+        C = a["num_classes"]
+        priors, variances = _split_priors(prior)
+        P = priors.shape[0]
+        loc_pred = loc.value.reshape(-1, P, 4)
+        conf_pred = conf.value.reshape(-1, P, C)
+
+        def per_image(lp, cp):
+            return D.detection_output(
+                lp,
+                cp,
+                priors,
+                variances,
+                num_classes=C,
+                background_id=a.get("background_id", 0),
+                nms_threshold=a.get("nms_threshold", 0.45),
+                nms_top_k=a.get("nms_top_k", 400),
+                keep_top_k=self._keep,
+                confidence_threshold=a.get("confidence_threshold", 0.01),
+            )
+
+        dets = jax.vmap(per_image)(loc_pred, conf_pred)  # [B,K,6]
+        return Arg(value=dets.reshape(dets.shape[0], -1))

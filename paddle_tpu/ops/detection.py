@@ -1,0 +1,290 @@
+"""SSD detection kernels: prior boxes, IoU, encode/decode, matching, NMS.
+
+Reference: paddle/gserver/layers/DetectionUtil.cpp (jaccardOverlap:91,
+encodeBBoxWithVar:112, decodeBBoxWithVar:137, matchBBox:234,
+applyNMSFast:432, getDetectionIndices:466) and PriorBox.cpp:79-152.
+
+TPU-first: everything is fixed-shape and jittable. Variable ground-truth
+counts use a [B, G_max] mask instead of the reference's variable-length
+label sequences; NMS runs as a bounded greedy `lax.fori_loop` producing a
+keep mask rather than host-side vectors. Boxes are (xmin, ymin, xmax,
+ymax), normalized to [0, 1].
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def prior_boxes(
+    layer_hw,
+    image_hw,
+    min_sizes,
+    max_sizes,
+    aspect_ratios,
+    variances,
+    flip: bool = True,
+    clip: bool = True,
+) -> np.ndarray:
+    """[P, 8] rows of (box4, variance4) — PriorBox.cpp:79-152 ordering:
+    per location, per min_size: min prior, then sqrt(min*max) prior, then
+    one prior per non-1 aspect ratio (input ratios + flipped)."""
+    lh, lw = layer_hw
+    ih, iw = image_hw
+    step_w, step_h = iw / lw, ih / lh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        ars.append(ar)
+        if flip:
+            ars.append(1.0 / ar)
+    rows = []
+    for h in range(lh):
+        for w in range(lw):
+            cx, cy = (w + 0.5) * step_w, (h + 0.5) * step_h
+            for s, mn in enumerate(min_sizes):
+                rows.append((cx, cy, mn, mn))
+                if max_sizes:
+                    m = math.sqrt(mn * max_sizes[s])
+                    rows.append((cx, cy, m, m))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    rows.append(
+                        (cx, cy, mn * math.sqrt(ar), mn / math.sqrt(ar))
+                    )
+    r = np.asarray(rows, np.float32)
+    boxes = np.stack(
+        [
+            (r[:, 0] - r[:, 2] / 2) / iw,
+            (r[:, 1] - r[:, 3] / 2) / ih,
+            (r[:, 0] + r[:, 2] / 2) / iw,
+            (r[:, 1] + r[:, 3] / 2) / ih,
+        ],
+        axis=1,
+    )
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(
+        np.asarray(variances, np.float32), boxes.shape
+    ).copy()
+    return np.concatenate([boxes, var], axis=1)
+
+
+def iou_matrix(a: jax.Array, b: jax.Array) -> jax.Array:
+    """[N, M] Jaccard overlap (DetectionUtil.cpp:91)."""
+    ax1, ay1, ax2, ay2 = jnp.split(a, 4, axis=-1)  # [N,1]
+    bx1, by1, bx2, by2 = (x[None, :, 0] for x in jnp.split(b, 4, axis=-1))
+    iw = jnp.maximum(jnp.minimum(ax2, bx2) - jnp.maximum(ax1, bx1), 0.0)
+    ih = jnp.maximum(jnp.minimum(ay2, by2) - jnp.maximum(ay1, by1), 0.0)
+    inter = iw * ih
+    area_a = (ax2 - ax1) * (ay2 - ay1)
+    area_b = (bx2 - bx1) * (by2 - by1)
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+def encode_boxes(priors: jax.Array, variances: jax.Array, gt: jax.Array):
+    """[P,4] regression targets (encodeBBoxWithVar)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    gw = gt[:, 2] - gt[:, 0]
+    gh = gt[:, 3] - gt[:, 1]
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    return jnp.stack(
+        [
+            (gcx - pcx) / jnp.maximum(pw, 1e-10) / variances[:, 0],
+            (gcy - pcy) / jnp.maximum(ph, 1e-10) / variances[:, 1],
+            jnp.log(jnp.abs(gw / jnp.maximum(pw, 1e-10)) + 1e-10)
+            / variances[:, 2],
+            jnp.log(jnp.abs(gh / jnp.maximum(ph, 1e-10)) + 1e-10)
+            / variances[:, 3],
+        ],
+        axis=1,
+    )
+
+
+def decode_boxes(priors: jax.Array, variances: jax.Array, loc: jax.Array):
+    """[P,4] decoded boxes (decodeBBoxWithVar)."""
+    pw = priors[:, 2] - priors[:, 0]
+    ph = priors[:, 3] - priors[:, 1]
+    pcx = (priors[:, 0] + priors[:, 2]) / 2
+    pcy = (priors[:, 1] + priors[:, 3]) / 2
+    cx = variances[:, 0] * loc[:, 0] * pw + pcx
+    cy = variances[:, 1] * loc[:, 1] * ph + pcy
+    w = jnp.exp(variances[:, 2] * loc[:, 2]) * pw
+    h = jnp.exp(variances[:, 3] * loc[:, 3]) * ph
+    return jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=1
+    )
+
+
+def match_boxes(
+    priors: jax.Array,
+    gt_boxes: jax.Array,
+    gt_mask: jax.Array,
+    overlap_threshold: float,
+):
+    """(match_idx [P] int32 with -1 = unmatched, match_overlap [P]).
+
+    DetectionUtil.cpp matchBBox:234 — bipartite phase: each ground truth
+    claims its globally-best free prior (greedy on max overlap); then
+    per-prediction phase: every still-free prior with best overlap >
+    threshold takes its argmax ground truth.
+    """
+    P, G = priors.shape[0], gt_boxes.shape[0]
+    ov = iou_matrix(priors, gt_boxes) * gt_mask[None, :]  # [P, G]
+
+    def bipartite(carry, _):
+        match_idx, gt_free = carry
+        m = ov * gt_free[None, :] * (match_idx == -1)[:, None]
+        flat = jnp.argmax(m)
+        pi, gj = flat // G, flat % G
+        valid = m[pi, gj] > 1e-6
+        match_idx = jnp.where(
+            valid, match_idx.at[pi].set(gj.astype(jnp.int32)), match_idx
+        )
+        gt_free = jnp.where(valid, gt_free.at[gj].set(0.0), gt_free)
+        return (match_idx, gt_free), None
+
+    init = (jnp.full((P,), -1, jnp.int32), gt_mask.astype(jnp.float32))
+    (match_idx, _), _ = jax.lax.scan(bipartite, init, None, length=G)
+
+    best_ov = jnp.max(ov, axis=1)
+    best_gt = jnp.argmax(ov, axis=1).astype(jnp.int32)
+    take = (match_idx == -1) & (best_ov > overlap_threshold)
+    match_idx = jnp.where(take, best_gt, match_idx)
+    return match_idx, best_ov
+
+
+@partial(jax.jit, static_argnames=("top_k",))
+def nms_mask(
+    boxes: jax.Array,
+    scores: jax.Array,
+    threshold: float,
+    top_k: int,
+) -> jax.Array:
+    """Greedy NMS keep-mask (applyNMSFast:432): scan scores descending,
+    keep a box iff IoU with every already-kept box <= threshold; at most
+    `top_k` kept. Returns [N] bool.
+
+    Only the top_k highest-scoring candidates are considered at all, so
+    the IoU matrix is k x k, not N x N — at SSD scale (P=8732, C=21) the
+    full matrix per class would be ~6 GB."""
+    N = boxes.shape[0]
+    k = min(top_k, N)
+    top_s, top_i = jax.lax.top_k(scores, k)
+    cb = boxes[top_i]
+    ov = iou_matrix(cb, cb)
+
+    def body(i, keep):
+        ok = jnp.all(jnp.where(keep, ov[i] <= threshold, True))
+        ok = ok & (top_s[i] > 0)
+        return keep.at[i].set(ok)
+
+    keep_c = jax.lax.fori_loop(0, k, body, jnp.zeros((k,), bool))
+    return jnp.zeros((N,), bool).at[top_i].set(keep_c)
+
+
+def multibox_loss(
+    loc_pred: jax.Array,
+    conf_logits: jax.Array,
+    priors: jax.Array,
+    variances: jax.Array,
+    gt_boxes: jax.Array,
+    gt_labels: jax.Array,
+    gt_mask: jax.Array,
+    overlap_threshold: float = 0.5,
+    neg_pos_ratio: float = 3.0,
+    neg_overlap: float = 0.5,
+    background_id: int = 0,
+):
+    """Per-image (loc_loss_sum, conf_loss_sum, num_matches).
+
+    MultiBoxLossLayer.cpp:160-260 — smooth-L1 on matched priors vs
+    encoded targets; softmax CE over matched priors (gt label) + hard
+    negatives (background label), negatives chosen as the highest-
+    conf-loss priors with overlap < neg_overlap, at most
+    neg_pos_ratio * num_pos. Caller divides both sums by the global
+    match count, exactly like locLoss_/confLoss_ normalization.
+    """
+    match_idx, match_ov = match_boxes(
+        priors[:, :4], gt_boxes, gt_mask, overlap_threshold
+    )
+    pos = match_idx >= 0
+    n_pos = jnp.sum(pos)
+
+    safe_idx = jnp.maximum(match_idx, 0)
+    gt_for_prior = gt_boxes[safe_idx]
+    targets = encode_boxes(priors[:, :4], variances, gt_for_prior)
+    d = jnp.abs(loc_pred - targets)
+    sl1 = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+    loc_loss = jnp.sum(jnp.where(pos[:, None], sl1, 0.0))
+
+    lse = jax.scipy.special.logsumexp(conf_logits, axis=-1)
+    label_for_prior = jnp.where(
+        pos, gt_labels[safe_idx], background_id
+    )
+    ce = lse - jnp.take_along_axis(
+        conf_logits, label_for_prior[:, None], axis=-1
+    )[:, 0]
+    pos_conf_loss = jnp.sum(jnp.where(pos, ce, 0.0))
+
+    # hard negative mining on background CE
+    bg_ce = lse - conf_logits[:, background_id]
+    neg_cand = (~pos) & (match_ov < neg_overlap)
+    neg_scores = jnp.where(neg_cand, bg_ce, -jnp.inf)
+    n_neg = jnp.minimum(
+        (neg_pos_ratio * n_pos).astype(jnp.int32), jnp.sum(neg_cand)
+    )
+    rank = jnp.argsort(jnp.argsort(-neg_scores))
+    neg = neg_cand & (rank < n_neg)
+    neg_conf_loss = jnp.sum(jnp.where(neg, bg_ce, 0.0))
+
+    return loc_loss, pos_conf_loss + neg_conf_loss, n_pos
+
+
+def detection_output(
+    loc_pred: jax.Array,
+    conf_logits: jax.Array,
+    priors: jax.Array,
+    variances: jax.Array,
+    num_classes: int,
+    background_id: int = 0,
+    nms_threshold: float = 0.45,
+    nms_top_k: int = 400,
+    keep_top_k: int = 200,
+    confidence_threshold: float = 0.01,
+) -> jax.Array:
+    """[keep_top_k, 6] rows (label, score, x1, y1, x2, y2), padded with
+    score 0 (DetectionOutputLayer.cpp + getDetectionIndices:466): decode,
+    per-class NMS over non-background classes, then keep the global
+    top-k by score."""
+    boxes = decode_boxes(priors[:, :4], variances, loc_pred)  # [P,4]
+    probs = jax.nn.softmax(conf_logits, axis=-1)  # [P,C]
+
+    def per_class(c):
+        pc = jnp.take(probs, c, axis=1)
+        s = jnp.where(
+            (c != background_id) & (pc > confidence_threshold), pc, 0.0
+        )
+        keep = nms_mask(boxes, s, nms_threshold, nms_top_k)
+        return jnp.where(keep, s, 0.0)
+
+    kept = jax.vmap(per_class)(jnp.arange(num_classes))  # [C,P]
+    flat = kept.reshape(-1)
+    k = min(keep_top_k, flat.shape[0])
+    top_s, top_i = jax.lax.top_k(flat, k)
+    cls = (top_i // boxes.shape[0]).astype(jnp.float32)
+    box = boxes[top_i % boxes.shape[0]]
+    rows = jnp.concatenate(
+        [cls[:, None], top_s[:, None], box], axis=1
+    )
+    out = jnp.zeros((keep_top_k, 6), jnp.float32)
+    return out.at[:k].set(jnp.where(top_s[:, None] > 0, rows, 0.0))

@@ -1,0 +1,265 @@
+"""SSD detection suite tests: prior boxes, matching, NMS, loss training,
+detection output, and mAP evaluation (reference:
+gserver/layers/{PriorBox,MultiBoxLossLayer,DetectionOutputLayer}.cpp,
+DetectionUtil.cpp, evaluators/DetectionMAPEvaluator.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import dsl
+from paddle_tpu.core.arg import Arg, id_arg, non_seq, seq
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.evaluators import create_evaluator
+from paddle_tpu.network import Network
+from paddle_tpu.ops import detection as D
+from paddle_tpu.optimizers import create_optimizer
+
+
+class TestPriorBoxes:
+    def test_count_and_range(self):
+        pb = D.prior_boxes(
+            layer_hw=(3, 3),
+            image_hw=(30, 30),
+            min_sizes=[10.0],
+            max_sizes=[20.0],
+            aspect_ratios=[2.0],
+            variances=[0.1, 0.1, 0.2, 0.2],
+        )
+        # per location: min + sqrt(min*max) + 2 flipped ratios = 4
+        assert pb.shape == (3 * 3 * 4, 8)
+        assert pb[:, :4].min() >= 0.0 and pb[:, :4].max() <= 1.0
+        np.testing.assert_allclose(
+            pb[:, 4:], np.tile([0.1, 0.1, 0.2, 0.2], (pb.shape[0], 1))
+        )
+        # first prior at cell (0,0): centered at (5,5), 10x10 box
+        np.testing.assert_allclose(
+            pb[0, :4], [0.0, 0.0, 1 / 3, 1 / 3], atol=1e-6
+        )
+
+    def test_iou(self):
+        a = jnp.asarray([[0.0, 0.0, 0.5, 0.5]])
+        b = jnp.asarray([[0.0, 0.0, 0.5, 0.5], [0.25, 0.25, 0.75, 0.75],
+                         [0.6, 0.6, 1.0, 1.0]])
+        iou = np.asarray(D.iou_matrix(a, b))[0]
+        np.testing.assert_allclose(iou[0], 1.0, atol=1e-6)
+        np.testing.assert_allclose(iou[1], 0.0625 / (0.5 - 0.0625), atol=1e-5)
+        assert iou[2] == 0.0
+
+    def test_encode_decode_roundtrip(self):
+        rng = np.random.default_rng(0)
+        priors = jnp.asarray(
+            np.sort(rng.uniform(0, 1, (7, 4)).astype(np.float32), axis=1)[
+                :, [0, 2, 1, 3]
+            ]
+        )
+        var = jnp.full((7, 4), 0.1, jnp.float32)
+        gt = jnp.asarray(
+            np.sort(rng.uniform(0, 1, (7, 4)).astype(np.float32), axis=1)[
+                :, [0, 2, 1, 3]
+            ]
+        )
+        dec = D.decode_boxes(priors, var, D.encode_boxes(priors, var, gt))
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(gt), atol=1e-4)
+
+
+class TestMatching:
+    def test_bipartite_then_threshold(self):
+        priors = jnp.asarray(
+            [
+                [0.0, 0.0, 0.4, 0.4],  # good for gt0
+                [0.05, 0.05, 0.45, 0.45],  # second-best for gt0
+                [0.5, 0.5, 0.9, 0.9],  # good for gt1
+                [0.0, 0.6, 0.2, 0.8],  # matches nothing
+            ]
+        )
+        gts = jnp.asarray([[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+        mask = jnp.ones(2)
+        idx, ov = D.match_boxes(priors, gts, mask, overlap_threshold=0.5)
+        idx = np.asarray(idx)
+        assert idx[0] == 0 and idx[2] == 1  # bipartite: each gt claimed
+        assert idx[1] == 0  # threshold phase: good overlap joins gt0
+        assert idx[3] == -1
+
+    def test_gt_mask_respected(self):
+        priors = jnp.asarray([[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+        gts = jnp.asarray([[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]])
+        idx, _ = D.match_boxes(priors, gts, jnp.asarray([1.0, 0.0]), 0.5)
+        idx = np.asarray(idx)
+        assert idx[0] == 0 and idx[1] == -1  # masked gt never matched
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = jnp.asarray(
+            [
+                [0.0, 0.0, 0.4, 0.4],
+                [0.01, 0.01, 0.41, 0.41],  # heavy overlap, lower score
+                [0.6, 0.6, 0.9, 0.9],
+            ]
+        )
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        keep = np.asarray(D.nms_mask(boxes, scores, 0.45, top_k=10))
+        assert keep.tolist() == [True, False, True]
+
+    def test_top_k_cap(self):
+        boxes = jnp.asarray(
+            [[i * 0.2, 0.0, i * 0.2 + 0.1, 0.1] for i in range(5)]
+        )
+        scores = jnp.asarray([0.9, 0.8, 0.7, 0.6, 0.5])
+        keep = np.asarray(D.nms_mask(boxes, scores, 0.45, top_k=2))
+        assert keep.sum() == 2 and keep[0] and keep[1]
+
+
+def _ssd_model(img_hw=(8, 8), num_classes=3, grid=4):
+    with dsl.model() as g:
+        img = dsl.data("image", (img_hw[0], img_hw[1], 3))
+        gt_box = dsl.data("gt_box", (4,), is_seq=True)
+        gt_label = dsl.data("gt_label", (1,), is_seq=True, is_ids=True)
+        feat = dsl.conv(img, 8, 3, stride=img_hw[0] // grid, padding=1,
+                        act="relu", name="feat")
+        pb = dsl.priorbox(feat, img, min_size=(2.0,), max_size=(4.0,),
+                          aspect_ratio=(2.0,), name="pb")
+        n_priors = grid * grid * 4
+        loc = dsl.fc(feat, size=n_priors * 4, name="loc")
+        conf = dsl.fc(feat, size=n_priors * num_classes, name="confp")
+        cost = dsl.multibox_loss(pb, gt_box, gt_label, loc, conf,
+                                 num_classes=num_classes, name="cost")
+        out = dsl.detection_output(pb, loc, conf, num_classes=num_classes,
+                                   keep_top_k=8, confidence_threshold=0.1,
+                                   name="detout")
+    return g.conf
+
+
+def _synth_batch(B=8, G=2, seed=0):
+    rng = np.random.default_rng(seed)
+    img = rng.standard_normal((B, 8, 8, 3)).astype(np.float32)
+    boxes = np.zeros((B, G, 4), np.float32)
+    labels = np.zeros((B, G), np.int32)
+    for b in range(B):
+        for gi in range(G):
+            x1, y1 = rng.uniform(0, 0.5, 2)
+            boxes[b, gi] = [x1, y1, x1 + 0.4, y1 + 0.4]
+            labels[b, gi] = rng.integers(1, 3)
+    lens = np.full(B, G, np.int32)
+    return img, boxes, labels, lens
+
+
+class TestMultiBoxLossTraining:
+    def test_ssd_loss_drops(self):
+        conf = _ssd_model()
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="adam", learning_rate=0.01),
+            net.param_confs,
+        )
+        opt_state = opt.init_state(params)
+        img, boxes, labels, lens = _synth_batch()
+        feed = {
+            "image": non_seq(jnp.asarray(img)),
+            "gt_box": seq(jnp.asarray(boxes), jnp.asarray(lens)),
+            "gt_label": id_arg(jnp.asarray(labels), jnp.asarray(lens)),
+        }
+
+        @jax.jit
+        def step(params, opt_state, i):
+            (loss, _), grads = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed, rng=jax.random.key(1))
+            params, opt_state = opt.update(grads, params, opt_state, i)
+            return params, opt_state, loss
+
+        losses = []
+        for i in range(40):
+            params, opt_state, loss = step(params, opt_state, i)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+    def test_detection_output_shape(self):
+        conf = _ssd_model()
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        img, boxes, labels, lens = _synth_batch(B=2)
+        feed = {
+            "image": non_seq(jnp.asarray(img)),
+            "gt_box": seq(jnp.asarray(boxes), jnp.asarray(lens)),
+            "gt_label": id_arg(jnp.asarray(labels), jnp.asarray(lens)),
+        }
+        outs, _ = net.forward(params, feed, outputs=["detout"])
+        assert outs["detout"].value.shape == (2, 8 * 6)
+
+
+class TestDetectionOutputOp:
+    def test_perfect_predictions_decode(self):
+        priors = jnp.asarray(
+            [[0.0, 0.0, 0.4, 0.4], [0.5, 0.5, 0.9, 0.9]], jnp.float32
+        )
+        var = jnp.full((2, 4), 0.1, jnp.float32)
+        gt = jnp.asarray([[0.1, 0.1, 0.3, 0.3]], jnp.float32)
+        loc = D.encode_boxes(priors, var, jnp.broadcast_to(gt, (2, 4)))
+        # prior 0 predicts class 1 strongly; prior 1 background
+        conf = jnp.asarray([[0.0, 9.0, 0.0], [9.0, 0.0, 0.0]])
+        dets = np.asarray(
+            D.detection_output(
+                loc, conf, priors, var, num_classes=3, keep_top_k=4,
+                confidence_threshold=0.2,
+            )
+        )
+        assert int(dets[0, 0]) == 1  # class
+        assert dets[0, 1] > 0.9  # score
+        np.testing.assert_allclose(dets[0, 2:], gt[0], atol=1e-3)
+        assert (dets[1:, 1] == 0).all()  # padding
+
+
+class TestDetectionMAP:
+    def _args(self, det_rows, boxes, labels, lens):
+        det = Arg(value=jnp.asarray(det_rows).reshape(len(det_rows), -1))
+        gt_box = seq(jnp.asarray(boxes), jnp.asarray(lens))
+        gt_label = id_arg(jnp.asarray(labels), jnp.asarray(lens))
+        return {"detout": det}, {
+            "gt_box": gt_box, "gt_label": gt_label,
+        }
+
+    def test_perfect_map(self):
+        ev = create_evaluator(
+            {"type": "detection_map", "input": "detout", "label": "gt_box",
+             "label_ids": "gt_label"}
+        )
+        boxes = np.asarray([[[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.8]]],
+                           np.float32)
+        labels = np.asarray([[1, 2]], np.int32)
+        det = np.zeros((1, 4, 6), np.float32)
+        det[0, 0] = [1, 0.9, 0.1, 0.1, 0.3, 0.3]
+        det[0, 1] = [2, 0.8, 0.5, 0.5, 0.8, 0.8]
+        outs, feed = self._args(det, boxes, labels, [2])
+        ev.add_batch(outs, feed)
+        assert ev.result() == 1.0
+
+    def test_false_positive_lowers_map(self):
+        ev = create_evaluator(
+            {"type": "detection_map", "input": "detout", "label": "gt_box",
+             "label_ids": "gt_label", "ap_type": "integral"}
+        )
+        boxes = np.asarray([[[0.1, 0.1, 0.3, 0.3]]], np.float32)
+        labels = np.asarray([[1]], np.int32)
+        det = np.zeros((1, 4, 6), np.float32)
+        det[0, 0] = [1, 0.9, 0.6, 0.6, 0.9, 0.9]  # FP (wrong place)
+        det[0, 1] = [1, 0.8, 0.1, 0.1, 0.3, 0.3]  # TP at lower score
+        outs, feed = self._args(det, boxes, labels, [1])
+        ev.add_batch(outs, feed)
+        r = ev.result()
+        assert 0.0 < r < 1.0
+
+    def test_missed_gt(self):
+        ev = create_evaluator(
+            {"type": "detection_map", "input": "detout", "label": "gt_box",
+             "label_ids": "gt_label"}
+        )
+        boxes = np.asarray([[[0.1, 0.1, 0.3, 0.3]]], np.float32)
+        labels = np.asarray([[1]], np.int32)
+        det = np.zeros((1, 2, 6), np.float32)  # no detections
+        outs, feed = self._args(det, boxes, labels, [1])
+        ev.add_batch(outs, feed)
+        assert ev.result() == 0.0
