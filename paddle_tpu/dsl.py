@@ -416,6 +416,69 @@ def multibox_loss(priorbox_ref, gt_box, gt_label, loc_pred, conf_pred,
                 background_id=background_id)
 
 
+# ---- long-tail layers (layers/extras.py) ----
+
+def selective_fc(x, select=None, *, size, name=None, act="", bias=True,
+                 param=None):
+    """(layers.py selective_fc_layer). `select` is a dense 0/1 mask layer
+    [B, size]; omitted -> plain fc behavior."""
+    ins = [x] if select is None else [x, select]
+    return _add("selective_fc", ins, name=name, size=size, act=act,
+                bias=bias, param=param)
+
+
+def conv_shift(a, b, name=None):
+    """Circular convolution (layers.py conv_shift_layer, NTM)."""
+    return _add("conv_shift", [a, b], name=name, bias=False)
+
+
+def bilinear_interp(x, out_size_x, out_size_y, name=None):
+    return _add("bilinear_interp", [x], name=name, bias=False,
+                out_size_x=out_size_x, out_size_y=out_size_y)
+
+
+def linear_comb(weights, vectors, size, name=None):
+    """(layers.py linear_comb_layer / convex_comb_layer)."""
+    return _add("convex_comb", [weights, vectors], name=name, size=size,
+                bias=False)
+
+
+def eos_id(x, eos_id, name=None):
+    return _add("eos_id", [x], name=name, bias=False, eos_id=eos_id)
+
+
+def power(weight, x, name=None):
+    return _add("power", [weight, x], name=name, bias=False)
+
+
+def clip(x, min=-1.0, max=1.0, name=None):
+    return _add("clip", [x], name=name, bias=False, min=min, max=max)
+
+
+def row_conv(x, context_length, name=None, param=None):
+    """Lookahead convolution (layers.py row_conv_layer, DS2)."""
+    return _add("row_conv", [x], name=name, bias=False, param=param,
+                context_length=context_length)
+
+
+def featmap_expand(x, num_filters, name=None):
+    return _add("featmap_expand", [x], name=name, bias=False,
+                num_filters=num_filters)
+
+
+def context_projection(x, context_length, context_start=None):
+    """A mixed()-input edge concatenating neighboring timesteps
+    (ContextProjection.h). Usage:
+    mixed(size=D*L, inputs=[context_projection(x, L, start)])."""
+    return (x, "context", {
+        "context_length": context_length,
+        "context_start": (
+            context_start if context_start is not None
+            else -(context_length // 2)
+        ),
+    })
+
+
 # ---- detection (SSD) ----
 
 def priorbox(feature, image, min_size, max_size=(), aspect_ratio=(),

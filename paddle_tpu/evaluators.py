@@ -604,7 +604,7 @@ class DetectionMAPEvaluator(Evaluator):
     def add_batch(self, outs, feed):
         det = self._get(outs, feed, "input")
         gt_box = self._get(outs, feed, "label")
-        gt_label = feed[self.conf["label_ids"]]
+        gt_label = self._get(outs, feed, "label_ids")
         thr = self.conf.get("overlap_threshold", 0.5)
         d = np.asarray(det.value)
         d = d.reshape(d.shape[0], -1, 6)

@@ -7,6 +7,7 @@ from paddle_tpu.layers import (  # noqa: F401
     conv,
     cost,
     detection,
+    extras,
     norm,
     pool,
     recurrent,

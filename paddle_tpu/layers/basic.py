@@ -262,6 +262,14 @@ class MixedLayer(Layer):
                 pcs[f"w{i}"] = self.weight_conf(i, (1,))
             elif proj == "identity":
                 assert s.size == out, f"identity proj size mismatch on {self.name}"
+            elif proj == "context":
+                # ContextProjection.h:18-43: concat context_length
+                # neighboring timesteps starting at offset context_start
+                L = ic.attrs["context_length"]
+                assert s.size * L == out, (
+                    f"context proj on {self.name}: {s.size}*{L} != {out}"
+                )
+                assert s.is_seq, "context projection needs a sequence input"
             else:
                 raise KeyError(f"unknown projection {proj!r}")
         b = self.bias_conf((out,))
@@ -288,6 +296,19 @@ class MixedLayer(Layer):
                 t = a.value * params[f"w{i}"]
             elif proj == "scaling":
                 t = a.value * params[f"w{i}"][0]
+            elif proj == "context":
+                from paddle_tpu.ops.sequence_ops import seq_shift
+
+                L = ic.attrs["context_length"]
+                start = ic.attrs.get("context_start", -(L // 2))
+                x = a.value  # [B, T, D]
+                t = jnp.concatenate(
+                    [
+                        seq_shift(x, a.seq_lens, start + o)
+                        for o in range(L)
+                    ],
+                    axis=-1,
+                )
             y = t if y is None else y + t
         if "b" in params:
             y = y + params["b"]

@@ -1,0 +1,211 @@
+"""Long-tail layers: selective FC, NTM conv-shift, bilinear interp,
+convex combination, EOS check, power, clip, row (lookahead) conv,
+feature-map expand.
+
+Reference: gserver/layers/{SelectiveFullyConnectedLayer,ConvShiftLayer,
+BilinearInterpLayer,ConvexCombinationLayer,EosIdCheckLayer,PowerLayer,
+ClipLayer,RowConvLayer,FeatureMapExpandLayer}.cpp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+
+
+@LAYERS.register("selective_fc")
+class SelectiveFCLayer(Layer):
+    """FC that only scores a selected subset of output columns
+    (SelectiveFullyConnectedLayer.h:20: with no selection it acts exactly
+    like fc). inputs: [x] or [x, sel] where sel.value is a dense 0/1 mask
+    [B, out] (the reference's sparse col-index rows, densified — TPU-first
+    static shape). Non-selected outputs are zeroed after activation."""
+
+    def build(self, in_specs):
+        out = self.conf.size
+        pcs = {"w0": self.weight_conf(0, (in_specs[0].size, out))}
+        b = self.bias_conf((out,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(out,), is_seq=in_specs[0].is_seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0]
+        y = jnp.dot(x.value, params["w0"])
+        if "b" in params:
+            y = y + params["b"]
+        sel = inputs[1].value if len(inputs) > 1 else None
+        if sel is not None and self.conf.active_type in (
+            "softmax",
+            "sequence_softmax",
+        ):
+            # restrict the softmax denominator to the selected columns
+            # (the reference computes softmax over selected cols only)
+            y = jnp.where(sel > 0, y, -1e9)
+        y = self.apply_activation_and_dropout(y, ctx, x.seq_lens)
+        if sel is not None:
+            y = y * sel
+        return Arg(value=y, seq_lens=x.seq_lens)
+
+
+@LAYERS.register("conv_shift")
+class ConvShiftLayer(Layer):
+    """Circular convolution (NTM addressing, ConvShiftLayer.cpp:22-41):
+    inputs [a (B,M), b (B,N)] with N odd;
+    c[i] = sum_{j=-(N-1)/2}^{(N-1)/2} a[(i+j) mod M] * b[j]."""
+
+    def build(self, in_specs):
+        sa, sb = in_specs
+        assert sb.size % 2 == 1, "conv_shift filter width must be odd"
+        self._n = sb.size
+        return Spec(dim=(sa.size,)), {}
+
+    def forward(self, params, inputs, ctx):
+        a, b = inputs[0].value, inputs[1].value
+        half = (self._n - 1) // 2
+        c = 0.0
+        for j in range(-half, half + 1):
+            c = c + jnp.roll(a, -j, axis=-1) * b[..., j + half : j + half + 1]
+        return Arg(value=c)
+
+
+@LAYERS.register("bilinear_interp")
+class BilinearInterpLayer(Layer):
+    """Bilinear resize of an (H, W, C) feature map
+    (BilinearInterpLayer.cpp). attrs: out_size_x (W), out_size_y (H)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        assert len(s.dim) == 3, "bilinear_interp needs an (H,W,C) input"
+        self._c = s.dim[2]
+        self._oh = self.conf.attrs["out_size_y"]
+        self._ow = self.conf.attrs["out_size_x"]
+        return Spec(dim=(self._oh, self._ow, self._c)), {}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0].value  # [B, H, W, C]
+        y = jax.image.resize(
+            x,
+            (x.shape[0], self._oh, self._ow, self._c),
+            method="bilinear",
+        )
+        return Arg(value=y)
+
+
+@LAYERS.register("convex_comb", "linear_comb")
+class ConvexCombLayer(Layer):
+    """Weighted combination of M sub-vectors
+    (ConvexCombinationLayer.cpp): inputs [w (B,M), x (B,M*D)];
+    out[b] = sum_m w[b,m] * x[b,m,:]."""
+
+    def build(self, in_specs):
+        sw, sx = in_specs
+        d = self.conf.size
+        assert sx.size == sw.size * d, (
+            f"convex_comb: {sx.size} != {sw.size} * {d}"
+        )
+        self._m = sw.size
+        return Spec(dim=(d,)), {}
+
+    def forward(self, params, inputs, ctx):
+        w, x = inputs[0].value, inputs[1].value
+        xm = x.reshape(x.shape[0], self._m, -1)
+        return Arg(value=jnp.einsum("bm,bmd->bd", w, xm))
+
+
+@LAYERS.register("eos_id")
+class EosIdCheckLayer(Layer):
+    """1.0 where the input id equals attrs["eos_id"]
+    (EosIdCheckLayer.cpp) — the beam-search stop signal."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        return Spec(dim=(1,), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        ids = inputs[0].ids
+        eos = self.conf.attrs["eos_id"]
+        v = (ids == eos).astype(jnp.float32)[..., None]
+        return Arg(value=v, seq_lens=inputs[0].seq_lens)
+
+
+@LAYERS.register("power")
+class PowerLayer(Layer):
+    """y = x^w with a per-example scalar exponent (PowerLayer.cpp:25):
+    inputs [w (B,1), x (B,D)]."""
+
+    def build(self, in_specs):
+        return Spec(dim=(in_specs[1].size,), is_seq=in_specs[1].is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        w, x = inputs[0].value, inputs[1].value
+        return Arg(
+            value=jnp.power(x, w), seq_lens=inputs[1].seq_lens
+        )
+
+
+@LAYERS.register("clip")
+class ClipLayer(Layer):
+    """Clamp to [attrs min, attrs max] (ClipLayer.cpp)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        return s, {}
+
+    def forward(self, params, inputs, ctx):
+        a = self.conf.attrs
+        x = inputs[0]
+        return x.with_value(
+            jnp.clip(x.value, a.get("min", -1.0), a.get("max", 1.0))
+        )
+
+
+@LAYERS.register("row_conv")
+class RowConvLayer(Layer):
+    """Lookahead (row) convolution over future timesteps
+    (RowConvLayer.h:24-43, DeepSpeech2): y[t] = sum_{j=0}^{L-1}
+    W[j] * x[t+j], weight [context_length, D], zero beyond sequence end."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        L = self.conf.attrs["context_length"]
+        self._L = L
+        pcs = {"w0": self.weight_conf(0, (L, s.size))}
+        return Spec(dim=(s.size,), is_seq=True), pcs
+
+    def forward(self, params, inputs, ctx):
+        from paddle_tpu.ops.sequence_ops import seq_shift
+
+        x = inputs[0].value  # [B, T, D]
+        w = params["w0"]
+        y = 0.0
+        for j in range(self._L):
+            # per-sequence shift: lookahead past a sequence's own end
+            # contributes zero, even when the batch is padded longer
+            y = y + seq_shift(x, inputs[0].seq_lens, j) * w[j]
+        return Arg(value=y, seq_lens=inputs[0].seq_lens)
+
+
+@LAYERS.register("featmap_expand")
+class FeatureMapExpandLayer(Layer):
+    """Tile a [B, D] vector across attrs["num_filters"] feature maps ->
+    [B, num_filters * D] (FeatureMapExpandLayer.cpp — broadcasting
+    attention weights over conv channels)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        n = self.conf.attrs["num_filters"]
+        self._n = n
+        return Spec(dim=(n * s.size,), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        x = inputs[0].value
+        y = jnp.repeat(x[..., None, :], self._n, axis=-2)
+        return Arg(
+            value=y.reshape(x.shape[:-1] + (-1,)),
+            seq_lens=inputs[0].seq_lens,
+        )

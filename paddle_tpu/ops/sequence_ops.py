@@ -98,6 +98,27 @@ def seq_concat(a, a_lens, b, b_lens):
     return out * valid.reshape(valid.shape + extra).astype(out.dtype), out_lens
 
 
+def seq_shift(x: jax.Array, seq_lens: jax.Array, shift: int) -> jax.Array:
+    """Per-sequence time shift with zero padding OUTSIDE each sequence's
+    own [0, seq_len) — not the batch's [0, T): y[b,t] = x[b,t+shift] when
+    both t and t+shift are inside sequence b, else 0. The building block
+    for context projection (ContextProjection.h:28-40) and lookahead
+    row conv; shifting the raw padded tensor instead would leak padding
+    content from short sequences into valid timesteps."""
+    T = x.shape[1]
+    src = jnp.arange(T) + shift  # [T] source positions
+    inside = (
+        (src >= 0)
+        & (src[None, :] < seq_lens[:, None])
+        & (jnp.arange(T)[None, :] < seq_lens[:, None])
+    )  # [B, T]
+    src_c = jnp.clip(src, 0, T - 1)
+    y = jnp.take(x, src_c, axis=1)
+    return jnp.where(
+        inside.reshape(inside.shape + (1,) * (x.ndim - 2)), y, 0
+    )
+
+
 def seq_slice_window(x, seq_lens, begin: int, size: int):
     """Static window slice along time (SeqSliceLayer, static case)."""
     sl = jnp.clip(seq_lens - begin, 0, size)
