@@ -1,0 +1,36 @@
+"""Dataset package (reference: python/paddle/v2/dataset/__init__.py —
+13 auto-downloading datasets). Zero-egress build: loaders parse cached
+files under common.DATA_HOME when present and otherwise emit
+deterministic synthetic streams with the reference schemas."""
+
+from paddle_tpu.data.dataset import (  # noqa: F401
+    cifar,
+    common,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+)
+
+__all__ = [
+    "cifar",
+    "common",
+    "conll05",
+    "flowers",
+    "imdb",
+    "imikolov",
+    "mnist",
+    "movielens",
+    "mq2007",
+    "sentiment",
+    "uci_housing",
+    "voc2012",
+    "wmt14",
+]

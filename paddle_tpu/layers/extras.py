@@ -62,7 +62,7 @@ class ConvShiftLayer(Layer):
         sa, sb = in_specs
         assert sb.size % 2 == 1, "conv_shift filter width must be odd"
         self._n = sb.size
-        return Spec(dim=(sa.size,)), {}
+        return Spec(dim=(sa.size,), is_seq=sa.is_seq), {}
 
     def forward(self, params, inputs, ctx):
         a, b = inputs[0].value, inputs[1].value
@@ -70,7 +70,7 @@ class ConvShiftLayer(Layer):
         c = 0.0
         for j in range(-half, half + 1):
             c = c + jnp.roll(a, -j, axis=-1) * b[..., j + half : j + half + 1]
-        return Arg(value=c)
+        return Arg(value=c, seq_lens=inputs[0].seq_lens)
 
 
 @LAYERS.register("bilinear_interp")
@@ -87,13 +87,27 @@ class BilinearInterpLayer(Layer):
         return Spec(dim=(self._oh, self._ow, self._c)), {}
 
     def forward(self, params, inputs, ctx):
+        # align-corners interpolation exactly as BilinearInterpLayer.cpp:
+        # ratio = (inSize-1)/(outSize-1), corners preserved (jax.image's
+        # "bilinear" is half-pixel-centers and would differ numerically)
         x = inputs[0].value  # [B, H, W, C]
-        y = jax.image.resize(
-            x,
-            (x.shape[0], self._oh, self._ow, self._c),
-            method="bilinear",
-        )
-        return Arg(value=y)
+        H, W = x.shape[1], x.shape[2]
+        oh, ow = self._oh, self._ow
+        ry = (H - 1) / (oh - 1) if oh > 1 else 0.0
+        rx = (W - 1) / (ow - 1) if ow > 1 else 0.0
+        ys = jnp.arange(oh) * ry
+        xs = jnp.arange(ow) * rx
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy = (ys - y0)[None, :, None, None]
+        wx = (xs - x0)[None, None, :, None]
+        r0 = x[:, y0]  # [B, oh, W, C]
+        r1 = x[:, y1]
+        top = r0[:, :, x0] * (1 - wx) + r0[:, :, x1] * wx
+        bot = r1[:, :, x0] * (1 - wx) + r1[:, :, x1] * wx
+        return Arg(value=top * (1 - wy) + bot * wy)
 
 
 @LAYERS.register("convex_comb", "linear_comb")
@@ -109,12 +123,15 @@ class ConvexCombLayer(Layer):
             f"convex_comb: {sx.size} != {sw.size} * {d}"
         )
         self._m = sw.size
-        return Spec(dim=(d,)), {}
+        return Spec(dim=(d,), is_seq=sx.is_seq), {}
 
     def forward(self, params, inputs, ctx):
         w, x = inputs[0].value, inputs[1].value
-        xm = x.reshape(x.shape[0], self._m, -1)
-        return Arg(value=jnp.einsum("bm,bmd->bd", w, xm))
+        xm = x.reshape(x.shape[:-1] + (self._m, -1))
+        return Arg(
+            value=jnp.einsum("...m,...md->...d", w, xm),
+            seq_lens=inputs[1].seq_lens,
+        )
 
 
 @LAYERS.register("eos_id")

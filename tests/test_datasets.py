@@ -1,0 +1,231 @@
+"""Dataset package tests (reference: python/paddle/v2/dataset/tests/):
+schema shape/dtype checks per module, determinism of the synthetic
+fallback, split/cluster_files_reader/convert plumbing, and an
+end-to-end train on the mnist stream."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data import reader as R
+from paddle_tpu.data.dataset import (
+    cifar,
+    common,
+    conll05,
+    flowers,
+    imdb,
+    imikolov,
+    mnist,
+    movielens,
+    mq2007,
+    sentiment,
+    uci_housing,
+    voc2012,
+    wmt14,
+)
+
+
+def take(reader, n):
+    out = []
+    for i, s in enumerate(reader()):
+        if i >= n:
+            break
+        out.append(s)
+    return out
+
+
+class TestSchemas:
+    def test_mnist(self):
+        samples = take(mnist.train(), 5)
+        img, label = samples[0]
+        assert img.shape == (784,) and img.dtype == np.float32
+        assert -1.0 <= img.min() and img.max() <= 1.0
+        assert 0 <= label <= 9
+
+    def test_cifar(self):
+        for rd, classes in [(cifar.train10(), 10), (cifar.train100(), 100)]:
+            img, label = take(rd, 1)[0]
+            assert img.shape == (3072,) and img.dtype == np.float32
+            assert 0 <= label < classes
+
+    def test_uci_housing(self):
+        x, y = take(uci_housing.train(), 1)[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # normalized features are centered-ish
+        assert abs(float(x.mean())) < 1.0
+
+    def test_imdb(self):
+        d = imdb.word_dict()
+        assert "<unk>" in d
+        ids, label = take(imdb.train(d), 1)[0]
+        assert all(isinstance(i, int) for i in ids)
+        assert label in (0, 1)
+        assert max(ids) < len(d)
+
+    def test_imikolov(self):
+        d = imikolov.build_dict(min_word_freq=2)
+        for g in take(imikolov.train(d, 4), 5):
+            assert len(g) == 4
+        src, trg = take(
+            imikolov.train(d, 0, imikolov.DataType.SEQ), 1
+        )[0]
+        assert src[0] == d["<s>"] and trg[-1] == d["<e>"]
+        assert src[1:] == trg[:-1]
+
+    def test_wmt14(self):
+        src, trg, trg_next = take(wmt14.train(30), 1)[0]
+        assert src[0] == wmt14.START_ID and src[-1] == wmt14.END_ID
+        assert trg[0] == wmt14.START_ID
+        assert trg_next[-1] == wmt14.END_ID
+        assert trg[1:] == trg_next[:-1]
+
+    def test_movielens(self):
+        s = take(movielens.train(), 1)[0]
+        user, gender, age, job, movie, cats, title, rating = s
+        assert 1 <= user <= movielens.max_user_id()
+        assert 1 <= movie <= movielens.max_movie_id()
+        assert 0 <= job <= movielens.max_job_id()
+        assert all(0 <= c < len(movielens.movie_categories()) for c in cats)
+        assert 1.0 <= rating[0] <= 5.0
+
+    def test_conll05(self):
+        wd, vd, ld = conll05.get_dict()
+        emb = conll05.get_embedding(16)
+        assert emb.shape == (len(wd), 16)
+        s = take(conll05.test(), 1)[0]
+        words, verb, n2, n1, c0, p1, p2, mark, labels = s
+        assert len(words) == len(mark) == len(labels)
+        assert 0 <= verb < len(vd)
+
+    def test_sentiment(self):
+        d = sentiment.get_word_dict()
+        ids, label = take(sentiment.train(), 1)[0]
+        assert label in (0, 1) and max(ids) < len(d)
+
+    def test_mq2007(self):
+        rel, feat = take(mq2007.train("pointwise"), 1)[0]
+        assert feat.shape == (mq2007.FEATURE_DIM,)
+        lbl, hi, lo = take(mq2007.train("pairwise"), 1)[0]
+        assert hi.shape == lo.shape == (mq2007.FEATURE_DIM,)
+        rels, feats = take(mq2007.train("listwise"), 1)[0]
+        assert feats.shape == (len(rels), mq2007.FEATURE_DIM)
+
+    def test_flowers_voc(self):
+        img, label = take(flowers.train(), 1)[0]
+        assert img.shape == (3 * 32 * 32,) and 0 <= label < 102
+        img, lbl = take(voc2012.train(), 1)[0]
+        assert img.shape[0] == 3 and lbl.shape == img.shape[1:]
+        assert lbl.max() < 21
+
+
+class TestDeterminism:
+    def test_same_stream_twice(self):
+        a = take(mnist.train(), 10)
+        b = take(mnist.train(), 10)
+        for (xa, la), (xb, lb) in zip(a, b):
+            assert la == lb
+            np.testing.assert_array_equal(xa, xb)
+
+    def test_train_test_differ(self):
+        a = take(mnist.train(), 5)
+        b = take(mnist.test(), 5)
+        assert any(
+            la != lb or not np.array_equal(xa, xb)
+            for (xa, la), (xb, lb) in zip(a, b)
+        )
+
+    def test_require_real_data(self):
+        common.require_real_data(True)
+        try:
+            with pytest.raises(FileNotFoundError):
+                take(mnist.train(), 1)
+        finally:
+            common.require_real_data(False)
+
+
+class TestPlumbing:
+    def test_split_and_cluster_reader(self, tmp_path):
+        rd = uci_housing.test()
+        files = common.split(
+            rd, 25, suffix=str(tmp_path / "h-%05d.pickle")
+        )
+        assert len(files) > 1
+        got = list(
+            common.cluster_files_reader(
+                str(tmp_path / "h-*.pickle"), trainer_count=2, trainer_id=0
+            )()
+        ) + list(
+            common.cluster_files_reader(
+                str(tmp_path / "h-*.pickle"), trainer_count=2, trainer_id=1
+            )()
+        )
+        assert len(got) == len(list(rd()))
+
+    def test_convert_recordio_roundtrip(self, tmp_path):
+        import pickle
+
+        rd = lambda: iter([(i, i * i) for i in range(10)])
+        paths = common.convert(str(tmp_path), rd, 4, "toy")
+        assert len(paths) == 3
+        from paddle_tpu.native.recordio import RecordReader
+
+        out = []
+        for p in paths:
+            with RecordReader(p) as r:
+                out.extend(pickle.loads(rec) for rec in r)
+        assert out == [(i, i * i) for i in range(10)]
+
+    def test_with_reader_combinators(self):
+        rd = R.buffered(R.shuffle(mnist.test(), 64), 32)
+        n = sum(1 for _ in rd())
+        assert n == 256
+
+
+class TestEndToEnd:
+    def test_mnist_lenet_learns(self):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu import dsl
+        from paddle_tpu.core.arg import id_arg, non_seq
+        from paddle_tpu.core.config import OptimizationConf
+        from paddle_tpu.network import Network
+        from paddle_tpu.optimizers import create_optimizer
+
+        with dsl.model() as g:
+            x = dsl.data("pixel", 784)
+            y = dsl.data("label", 1, is_ids=True)
+            h = dsl.fc(x, size=64, act="relu")
+            out = dsl.fc(h, size=10)
+            dsl.classification_cost(out, y, name="cost")
+        net = Network(g.conf)
+        params = net.init_params(jax.random.key(0))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="adam", learning_rate=0.005),
+            net.param_confs,
+        )
+        st = opt.init_state(params)
+
+        @jax.jit
+        def step(params, st, xb, yb, i):
+            feed = {"pixel": non_seq(xb), "label": id_arg(yb)}
+            (l, _), grads = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed)
+            params, st = opt.update(grads, params, st, i)
+            return params, st, l
+
+        batches = list(R.batched(mnist.train(), 64)())
+        first = last = None
+        i = 0
+        for _ in range(3):
+            for batch in batches:
+                xb = jnp.asarray(np.stack([s[0] for s in batch]))
+                yb = jnp.asarray([s[1] for s in batch], jnp.int32)
+                params, st, l = step(params, st, xb, yb, i)
+                if first is None:
+                    first = float(l)
+                i += 1
+            last = float(l)
+        assert last < first * 0.3, (first, last)

@@ -108,6 +108,20 @@ def test_bilinear_interp_grad_and_values():
     )
     assert outs["out"].value.shape == (1, 5, 7, 1)
     np.testing.assert_allclose(np.asarray(outs["out"].value), 1.0, rtol=1e-5)
+    # align-corners (BilinearInterpLayer.cpp): a ramp keeps exact corner
+    # values and interpolates linearly with ratio (in-1)/(out-1)
+    with dsl.model() as g2:
+        img2 = dsl.data("img", (2, 2, 1))
+        dsl.bilinear_interp(img2, 3, 3, name="out")
+    net2 = Network(g2.conf)
+    p2 = net2.init_params(jax.random.key(0))
+    ramp = jnp.asarray([[[[0.0], [1.0]], [[2.0], [3.0]]]])
+    outs2, _ = net2.forward(p2, {"img": non_seq(ramp)}, outputs=["out"])
+    np.testing.assert_allclose(
+        np.asarray(outs2["out"].value)[0, :, :, 0],
+        [[0.0, 0.5, 1.0], [1.0, 1.5, 2.0], [2.0, 2.5, 3.0]],
+        atol=1e-6,
+    )
 
 
 def test_convex_comb_grad_and_values():
