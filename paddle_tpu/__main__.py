@@ -1,0 +1,220 @@
+"""The `paddle` CLI dispatcher.
+
+Reference: paddle/scripts/submit_local.sh.in:3-13 — subcommands
+train / pserver / merge_model / dump_config / make_diagram / version —
+plus trainer/TrainerMain.cpp and trainer/MergeModel.cpp. TPU-native
+differences: there is no pserver process (data parallelism is one pjit
+program; `master` serves the elastic-input role instead), and `bench`
+wraps the repo benchmark harness.
+
+A config file is a Python source that defines:
+    get_config() -> (ModelConf, OptimizationConf)
+and optionally:
+    train_reader() / test_reader()   (batched sample readers)
+    feeder(batch) -> feed dict of Args
+
+Usage:  python -m paddle_tpu <cmd> [args]   (installed alias: paddle)
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import sys
+
+
+def _load_config(path: str):
+    spec = importlib.util.spec_from_file_location("_paddle_config", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "get_config"):
+        raise SystemExit(
+            f"{path} must define get_config() -> (ModelConf, "
+            f"OptimizationConf)"
+        )
+    return mod
+
+
+def cmd_version(args):
+    from paddle_tpu import __version__
+
+    print(f"paddle_tpu {__version__}")
+    import jax
+
+    print(f"jax {jax.__version__}, devices: {jax.devices()}")
+    return 0
+
+
+def cmd_dump_config(args):
+    mod = _load_config(args.config)
+    model_conf, opt_conf = mod.get_config()
+    doc = {
+        "model": json.loads(model_conf.to_json()),
+        "optimization": vars(opt_conf),
+    }
+    out = json.dumps(doc, indent=2, default=str)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(out)
+    else:
+        print(out)
+    return 0
+
+
+def cmd_train(args):
+    from paddle_tpu.trainer import SGD
+    from paddle_tpu.trainer import events
+
+    mod = _load_config(args.config)
+    model_conf, opt_conf = mod.get_config()
+    trainer = SGD(model_conf, opt_conf)
+    reader = mod.train_reader()
+    feeder = getattr(mod, "feeder", None)
+    if feeder is None:
+        raise SystemExit(f"{args.config} must define feeder(batch)")
+
+    def handler(ev):
+        if isinstance(ev, events.EndIteration) and (
+            ev.batch_id % args.log_period == 0
+        ):
+            print(
+                f"pass {ev.pass_id} batch {ev.batch_id} "
+                f"cost {ev.cost:.6f}"
+            )
+
+    trainer.train(
+        reader=reader,
+        feeder=feeder,
+        num_passes=args.num_passes,
+        event_handler=handler,
+        save_dir=args.save_dir or None,
+    )
+    return 0
+
+
+def cmd_merge_model(args):
+    from paddle_tpu.trainer import checkpoint as ckpt
+
+    mod = _load_config(args.config)
+    model_conf, _ = mod.get_config()
+    params, _, state, _ = ckpt.load_pass(args.model_dir, args.pass_id)
+    ckpt.merge_model(args.output, model_conf, params, state)
+    print(f"merged {args.model_dir} (pass {args.pass_id}) -> {args.output}")
+    return 0
+
+
+def cmd_infer(args):
+    import numpy as np
+
+    from paddle_tpu.trainer.trainer import Inferencer
+
+    inf = Inferencer.from_merged(args.model)
+    print(f"outputs: {inf.output_names}")
+    if args.example:
+        # feed zero batches of the declared shapes as a smoke test
+        from paddle_tpu.core.arg import Arg
+
+        feed = {}
+        for lc in inf.net.conf.layers:
+            if lc.type != "data":
+                continue
+            a = lc.attrs
+            shape = (args.batch,) + tuple(a["dim"])
+            if a.get("is_ids"):
+                feed[lc.name] = Arg(
+                    ids=np.zeros(shape[:-1], np.int32)
+                )
+            else:
+                feed[lc.name] = Arg(value=np.zeros(shape, np.float32))
+        outs = inf.infer(feed)
+        for n, v in outs.items():
+            print(f"{n}: shape {v.shape}")
+    return 0
+
+
+def cmd_master(args):
+    from paddle_tpu.native.master import Master
+    from paddle_tpu.native.recordio import count_chunks
+
+    m = Master(lease_seconds=args.timeout, failure_max=args.failure_max)
+    total = 0
+    for path in args.chunks:
+        n = count_chunks(path)
+        m.add_chunk_tasks(path, n)
+        total += n
+    print(
+        f"elastic master over {len(args.chunks)} files / {total} chunk "
+        f"tasks; Ctrl-C to stop"
+    )
+    import time
+
+    try:
+        while True:
+            time.sleep(30)
+            if args.snapshot:
+                m.snapshot(args.snapshot)
+    except KeyboardInterrupt:
+        if args.snapshot:
+            m.snapshot(args.snapshot)
+    return 0
+
+
+def cmd_bench(args):
+    import runpy
+
+    sys.argv = ["bench.py"]
+    runpy.run_path(args.script, run_name="__main__")
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="paddle", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("train", help="train a config")
+    sp.add_argument("--config", required=True)
+    sp.add_argument("--num_passes", type=int, default=1)
+    sp.add_argument("--save_dir", default="")
+    sp.add_argument("--log_period", type=int, default=10)
+    sp.set_defaults(fn=cmd_train)
+
+    sp = sub.add_parser("dump_config", help="print config as JSON")
+    sp.add_argument("--config", required=True)
+    sp.add_argument("--output", default="")
+    sp.set_defaults(fn=cmd_dump_config)
+
+    sp = sub.add_parser("merge_model", help="pack config+weights")
+    sp.add_argument("--config", required=True)
+    sp.add_argument("--model_dir", required=True)
+    sp.add_argument("--pass_id", type=int, default=-1)
+    sp.add_argument("--output", required=True)
+    sp.set_defaults(fn=cmd_merge_model)
+
+    sp = sub.add_parser("infer", help="load a merged model")
+    sp.add_argument("--model", required=True)
+    sp.add_argument("--example", action="store_true",
+                    help="run a zero-batch smoke forward")
+    sp.add_argument("--batch", type=int, default=1)
+    sp.set_defaults(fn=cmd_infer)
+
+    sp = sub.add_parser("master", help="run the elastic input master")
+    sp.add_argument("chunks", nargs="+")
+    sp.add_argument("--timeout", type=float, default=60.0)
+    sp.add_argument("--failure_max", type=int, default=3)
+    sp.add_argument("--snapshot", default="")
+    sp.set_defaults(fn=cmd_master)
+
+    sp = sub.add_parser("bench", help="run the benchmark harness")
+    sp.add_argument("--script", default="bench.py")
+    sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser("version", help="print versions")
+    sp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

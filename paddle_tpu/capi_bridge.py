@@ -1,0 +1,95 @@
+"""Python side of the C inference ABI.
+
+The C library (native/src/capi.cc) embeds CPython — the same trick the
+reference uses to run Python config parsing inside the C++ trainer
+(utils/PythonUtil.h) — and calls these functions with raw buffer
+addresses. All numpy/ctypes marshaling lives here so the C side stays a
+thin ABI: create (load merged model), forward (fill caller buffers),
+destroy.
+
+Reference surface being reproduced: paddle/capi/gradient_machine.h:36-75
+(paddle_gradient_machine_create_for_inference_with_parameters + forward)
+with capi/matrix.h-style dense row-major float buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+    # serving hosts without an accelerator (and the CI that exercises the
+    # C ABI) force the CPU backend before jax initializes
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+_HANDLES: dict = {}
+_NEXT = [1]
+
+
+def create(merged_path: str, output_layer: str = "") -> int:
+    """Load a merged model file; returns an integer handle."""
+    from paddle_tpu.trainer.trainer import Inferencer
+
+    inf = Inferencer.from_merged(
+        merged_path, outputs=[output_layer] if output_layer else None
+    )
+    h = _NEXT[0]
+    _NEXT[0] += 1
+    _HANDLES[h] = inf
+    return h
+
+
+def output_dim(h: int) -> int:
+    inf = _HANDLES[h]
+    name = inf.output_names[0]
+    spec = inf.net.specs[name]
+    return int(spec.size)
+
+
+def forward(
+    h: int,
+    names: list,
+    addrs: list,
+    shapes: list,
+    is_ids: list,
+    out_addr: int,
+    out_capacity: int,
+) -> list:
+    """Run inference. Inputs arrive as (name, buffer address, shape,
+    is_ids) quadruples; the first output layer's value is written into
+    out_addr (float32, row-major) if it fits. Returns the output shape
+    as a list of ints."""
+    from paddle_tpu.core.arg import Arg
+
+    inf = _HANDLES[h]
+    feed = {}
+    for name, addr, shape, ids in zip(names, addrs, shapes, is_ids):
+        n = int(np.prod(shape))
+        if ids:
+            buf = (ctypes.c_int32 * n).from_address(addr)
+            arr = np.frombuffer(buf, np.int32).reshape(shape).copy()
+            feed[name] = Arg(ids=arr)
+        else:
+            buf = (ctypes.c_float * n).from_address(addr)
+            arr = np.frombuffer(buf, np.float32).reshape(shape).copy()
+            feed[name] = Arg(value=arr)
+    outs = inf.infer(feed)
+    out = np.ascontiguousarray(
+        outs[inf.output_names[0]], np.float32
+    )
+    if out.size > out_capacity:
+        raise ValueError(
+            f"output needs {out.size} floats, caller buffer has "
+            f"{out_capacity}"
+        )
+    dst = (ctypes.c_float * out.size).from_address(out_addr)
+    ctypes.memmove(dst, out.ctypes.data, out.nbytes)
+    return list(out.shape)
+
+
+def destroy(h: int) -> None:
+    _HANDLES.pop(h, None)

@@ -1,0 +1,184 @@
+// C inference ABI over the paddle_tpu runtime.
+//
+// Reference surface: paddle/capi/gradient_machine.h:36-75
+// (paddle_gradient_machine_create_for_inference_with_parameters,
+// paddle_gradient_machine_forward) and capi/matrix.h dense buffers.
+// Like the reference trainer embedding Python for config parsing
+// (paddle/utils/PythonUtil.h), this library embeds CPython and defers
+// marshaling to paddle_tpu/capi_bridge.py; the exported surface is a
+// pure C ABI a serving process can dlopen with no Python headers.
+//
+// Build: make -C paddle_tpu/native capi   (links libpython).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mu;
+std::string g_error;
+bool g_we_initialized = false;
+PyThreadState* g_main_tstate = nullptr;
+
+void set_error(const char* what) {
+  g_error = what ? what : "unknown error";
+  if (PyErr_Occurred()) {
+    PyObject *type, *value, *tb;
+    PyErr_Fetch(&type, &value, &tb);
+    if (value) {
+      PyObject* s = PyObject_Str(value);
+      if (s) {
+        g_error += ": ";
+        g_error += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+}
+
+PyObject* bridge() {
+  static PyObject* mod = nullptr;
+  if (!mod) {
+    mod = PyImport_ImportModule("paddle_tpu.capi_bridge");
+  }
+  return mod;
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Initialize the runtime. `repo_path` (may be null) is prepended to
+// sys.path so `import paddle_tpu` resolves. Returns 0 on success.
+int pt_capi_init(const char* repo_path) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+  }
+  int rc = 0;
+  {
+    Gil gil;
+    if (repo_path && *repo_path) {
+      PyObject* sys_path = PySys_GetObject("path");  // borrowed
+      PyObject* p = PyUnicode_FromString(repo_path);
+      if (!sys_path || !p || PyList_Insert(sys_path, 0, p) != 0) {
+        Py_XDECREF(p);
+        set_error("cannot extend sys.path");
+        rc = -1;
+      } else {
+        Py_DECREF(p);
+      }
+    }
+    if (rc == 0 && !bridge()) {
+      set_error("cannot import paddle_tpu.capi_bridge");
+      rc = -1;
+    }
+  }
+  // Py_InitializeEx leaves the calling thread holding the GIL; release
+  // it so pt_capi_* calls from OTHER threads (the normal serving
+  // pattern) can PyGILState_Ensure without deadlocking on this thread.
+  if (g_we_initialized && g_main_tstate == nullptr && PyGILState_Check()) {
+    g_main_tstate = PyEval_SaveThread();
+  }
+  return rc;
+}
+
+// Load a merged model (trainer/MergeModel.cpp analogue). Returns a
+// handle > 0, or 0 on error.
+int64_t pt_capi_create(const char* merged_path, const char* output_layer) {
+  Gil gil;
+  PyObject* m = bridge();
+  if (!m) {
+    set_error("runtime not initialized");
+    return 0;
+  }
+  PyObject* r = PyObject_CallMethod(
+      m, "create", "ss", merged_path, output_layer ? output_layer : "");
+  if (!r) {
+    set_error("create failed");
+    return 0;
+  }
+  int64_t h = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return h;
+}
+
+// Total per-example output width of the first output layer.
+int64_t pt_capi_output_dim(int64_t handle) {
+  Gil gil;
+  PyObject* r =
+      PyObject_CallMethod(bridge(), "output_dim", "L", (long long)handle);
+  if (!r) {
+    set_error("output_dim failed");
+    return -1;
+  }
+  int64_t d = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return d;
+}
+
+// Forward one batch. n_inputs parallel arrays describe the feed:
+// names[i]; bufs[i] (float32 row-major, or int32 when is_ids[i]);
+// shapes[i] points at ndims[i] int64 dims. The first output layer's
+// value is written to out_buf (capacity out_cap floats); its shape is
+// written to out_shape (capacity 8), returning the output rank, or -1.
+int pt_capi_forward(int64_t handle, const char** names, const void** bufs,
+                    const int64_t** shapes, const int* ndims,
+                    const int* is_ids, int n_inputs, float* out_buf,
+                    int64_t out_cap, int64_t* out_shape) {
+  Gil gil;
+  PyObject *py_names = PyList_New(n_inputs),
+           *py_addrs = PyList_New(n_inputs),
+           *py_shapes = PyList_New(n_inputs),
+           *py_ids = PyList_New(n_inputs);
+  for (int i = 0; i < n_inputs; ++i) {
+    PyList_SetItem(py_names, i, PyUnicode_FromString(names[i]));
+    PyList_SetItem(py_addrs, i, PyLong_FromVoidPtr((void*)bufs[i]));
+    PyObject* shp = PyList_New(ndims[i]);
+    for (int d = 0; d < ndims[i]; ++d)
+      PyList_SetItem(shp, d, PyLong_FromLongLong(shapes[i][d]));
+    PyList_SetItem(py_shapes, i, shp);
+    PyList_SetItem(py_ids, i, PyBool_FromLong(is_ids[i]));
+  }
+  PyObject* r = PyObject_CallMethod(
+      bridge(), "forward", "LOOOOLL", (long long)handle, py_names, py_addrs,
+      py_shapes, py_ids, (long long)(intptr_t)out_buf, (long long)out_cap);
+  Py_DECREF(py_names);
+  Py_DECREF(py_addrs);
+  Py_DECREF(py_shapes);
+  Py_DECREF(py_ids);
+  if (!r) {
+    set_error("forward failed");
+    return -1;
+  }
+  int rank = (int)PyList_Size(r);
+  for (int d = 0; d < rank && d < 8; ++d)
+    out_shape[d] = PyLong_AsLongLong(PyList_GetItem(r, d));
+  Py_DECREF(r);
+  return rank;
+}
+
+void pt_capi_destroy(int64_t handle) {
+  Gil gil;
+  PyObject* r =
+      PyObject_CallMethod(bridge(), "destroy", "L", (long long)handle);
+  Py_XDECREF(r);
+}
+
+const char* pt_capi_error() { return g_error.c_str(); }
+
+}  // extern "C"

@@ -1,0 +1,238 @@
+"""CLI, inference API, AOT export, and the C inference ABI.
+
+Reference: paddle/scripts/submit_local.sh.in (CLI surface),
+python/paddle/v2/inference.py, trainer/MergeModel.cpp, and
+paddle/capi/examples (a pure-C program loads a merged model and runs
+forward)."""
+
+import ctypes
+import os
+import subprocess
+import sys
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.__main__ as cli
+from paddle_tpu import dsl, inference
+from paddle_tpu.core.arg import non_seq
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.network import Network
+from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.trainer.trainer import Inferencer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG_SRC = textwrap.dedent(
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu import dsl
+    from paddle_tpu.core.arg import id_arg, non_seq
+    from paddle_tpu.core.config import OptimizationConf
+
+    def get_config():
+        with dsl.model() as g:
+            x = dsl.data("x", 8)
+            y = dsl.data("y", 1, is_ids=True)
+            h = dsl.fc(x, size=16, act="tanh")
+            out = dsl.fc(h, size=3, name="output")
+            dsl.classification_cost(out, y, name="cost")
+        return g.conf, OptimizationConf(
+            learning_method="sgd", learning_rate=0.1, momentum=0.9)
+
+    def train_reader():
+        def r():
+            rng = np.random.default_rng(0)
+            w = rng.standard_normal((8, 3))
+            for _ in range(6):
+                xs = rng.standard_normal((16, 8)).astype("float32")
+                ys = np.argmax(xs @ w, axis=1).astype("int32")
+                yield list(zip(xs, ys))
+        return r
+
+    def feeder(batch):
+        x = jnp.asarray(np.stack([b[0] for b in batch]))
+        y = jnp.asarray(np.asarray([b[1] for b in batch]), jnp.int32)
+        return {"x": non_seq(x), "y": id_arg(y)}
+    """
+)
+
+
+def _write_config(tmp_path):
+    p = tmp_path / "conf.py"
+    p.write_text(CONFIG_SRC)
+    return str(p)
+
+
+def _merged_model(tmp_path):
+    """Train-free merged model for inference tests."""
+    mod_path = _write_config(tmp_path)
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_c", mod_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    conf, _ = mod.get_config()
+    net = Network(conf)
+    params = net.init_params(jax.random.key(3))
+    merged = str(tmp_path / "model.npz")
+    ckpt.merge_model(merged, conf, params)
+    return merged, net, params
+
+
+class TestCLI:
+    def test_version(self, capsys):
+        assert cli.main(["version"]) == 0
+        out = capsys.readouterr().out
+        assert "paddle_tpu" in out and "jax" in out
+
+    def test_dump_config(self, tmp_path, capsys):
+        assert cli.main(["dump_config", "--config",
+                         _write_config(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert '"model"' in out and '"output"' in out
+
+    def test_train_merge_infer_roundtrip(self, tmp_path, capsys):
+        conf = _write_config(tmp_path)
+        save = str(tmp_path / "out")
+        assert cli.main([
+            "train", "--config", conf, "--num_passes", "2",
+            "--save_dir", save, "--log_period", "3",
+        ]) == 0
+        assert any(n.startswith("pass-") for n in os.listdir(save))
+        merged = str(tmp_path / "m.npz")
+        assert cli.main([
+            "merge_model", "--config", conf, "--model_dir", save,
+            "--output", merged,
+        ]) == 0
+        assert cli.main(["infer", "--model", merged, "--example"]) == 0
+        out = capsys.readouterr().out
+        assert "output" in out
+
+
+class TestInferenceAPI:
+    def test_infer_one_shot(self, tmp_path):
+        merged, net, params = _merged_model(tmp_path)
+        x = np.ones((2, 8), np.float32)
+        got = inference.infer(
+            output="output", parameters=params, network=net,
+            input={"x": non_seq(jnp.asarray(x))},
+        )
+        assert got.shape == (2, 3)
+
+    def test_export_compiled_roundtrip(self, tmp_path):
+        merged, net, params = _merged_model(tmp_path)
+        inf = Inferencer.from_merged(merged, outputs=["output"])
+        feed = {"x": non_seq(jnp.ones((2, 8), jnp.float32))}
+        blob = inference.export_compiled(inf, feed)
+        assert isinstance(blob, (bytes, bytearray)) and len(blob) > 100
+        fn = inference.load_compiled(blob)
+        out = fn(inf.params, inf.state, feed)
+        want = inf.infer(feed)["output"]
+        np.testing.assert_allclose(
+            np.asarray(out["output"].value), want, rtol=1e-5
+        )
+
+
+CAPI_C_SRC = textwrap.dedent(
+    """
+    #include <dlfcn.h>
+    #include <pthread.h>
+    #include <stdint.h>
+    #include <stdio.h>
+
+    static int (*fwd)(int64_t, const char**, const void**, const int64_t**,
+                      const int*, const int*, int, float*, int64_t,
+                      int64_t*);
+    static const char* (*err)();
+    static int64_t g_h;
+    static float g_out[64];
+    static int64_t g_oshape[8];
+    static int g_rank = -1;
+
+    /* runs on a NON-init thread: the serving pattern; deadlocks if init
+       leaves the GIL held */
+    static void* worker(void* arg) {
+      float in[16];
+      for (int i = 0; i < 16; ++i) in[i] = (float)i / 16.0f;
+      const char* names[] = {"x"};
+      const void* bufs[] = {in};
+      int64_t shape[] = {2, 8};
+      const int64_t* shapes[] = {shape};
+      int ndims[] = {2};
+      int isids[] = {0};
+      g_rank = fwd(g_h, names, bufs, shapes, ndims, isids, 1, g_out, 64,
+                   g_oshape);
+      return 0;
+    }
+
+    int main(int argc, char** argv) {
+      void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+      if (!lib) { fprintf(stderr, "dlopen: %s\\n", dlerror()); return 2; }
+      int (*init)(const char*) = dlsym(lib, "pt_capi_init");
+      int64_t (*create)(const char*, const char*) =
+          dlsym(lib, "pt_capi_create");
+      fwd = dlsym(lib, "pt_capi_forward");
+      err = dlsym(lib, "pt_capi_error");
+      void (*destroy)(int64_t) = dlsym(lib, "pt_capi_destroy");
+      if (init(argv[2]) != 0) { fprintf(stderr, "init: %s\\n", err()); return 3; }
+      g_h = create(argv[3], "output");
+      if (!g_h) { fprintf(stderr, "create: %s\\n", err()); return 4; }
+      pthread_t t;
+      pthread_create(&t, 0, worker, 0);
+      pthread_join(t, 0);
+      if (g_rank < 0) { fprintf(stderr, "fwd: %s\\n", err()); return 5; }
+      int64_t n = 1;
+      for (int d = 0; d < g_rank; ++d) n *= g_oshape[d];
+      for (int64_t i = 0; i < n; ++i) printf("%.6f\\n", g_out[i]);
+      destroy(g_h);
+      return 0;
+    }
+    """
+)
+
+
+class TestCAPI:
+    def test_c_program_matches_python(self, tmp_path):
+        lib = os.path.join(
+            REPO, "paddle_tpu/native/lib/libpaddle_tpu_capi.so"
+        )
+        if not os.path.exists(lib):
+            r = subprocess.run(
+                ["make", "-C", os.path.join(REPO, "paddle_tpu/native"),
+                 "capi"],
+                capture_output=True,
+            )
+            assert r.returncode == 0, r.stderr.decode()
+        merged, net, params = _merged_model(tmp_path)
+
+        csrc = tmp_path / "example.c"
+        csrc.write_text(CAPI_C_SRC)
+        exe = str(tmp_path / "example")
+        r = subprocess.run(
+            ["gcc", str(csrc), "-o", exe, "-ldl", "-lpthread"], capture_output=True
+        )
+        assert r.returncode == 0, r.stderr.decode()
+
+        env = dict(os.environ)
+        env["PADDLE_TPU_FORCE_CPU"] = "1"
+        env.pop("JAX_PLATFORMS", None)
+        r = subprocess.run(
+            [exe, lib, REPO, merged],
+            capture_output=True,
+            env=env,
+            timeout=300,
+        )
+        assert r.returncode == 0, (r.stdout.decode(), r.stderr.decode())
+        got = np.asarray(
+            [float(line) for line in r.stdout.decode().split()]
+        ).reshape(2, 3)
+
+        x = (np.arange(16, dtype=np.float32) / 16.0).reshape(2, 8)
+        inf = Inferencer(net, params, outputs=["output"])
+        want = inf.infer({"x": non_seq(jnp.asarray(x))})["output"]
+        np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
