@@ -116,17 +116,25 @@ def cmd_infer(args):
         from paddle_tpu.core.arg import Arg
 
         feed = {}
+        T = 4  # smoke-test time steps for sequence inputs
         for lc in inf.net.conf.layers:
             if lc.type != "data":
                 continue
             a = lc.attrs
-            shape = (args.batch,) + tuple(a["dim"])
+            is_seq = a.get("is_seq", False)
+            lead = (args.batch, T) if is_seq else (args.batch,)
+            lens = (
+                np.full(args.batch, T, np.int32) if is_seq else None
+            )
             if a.get("is_ids"):
                 feed[lc.name] = Arg(
-                    ids=np.zeros(shape[:-1], np.int32)
+                    ids=np.zeros(lead, np.int32), seq_lens=lens
                 )
             else:
-                feed[lc.name] = Arg(value=np.zeros(shape, np.float32))
+                feed[lc.name] = Arg(
+                    value=np.zeros(lead + tuple(a["dim"]), np.float32),
+                    seq_lens=lens,
+                )
         outs = inf.infer(feed)
         for n, v in outs.items():
             print(f"{n}: shape {v.shape}")

@@ -26,8 +26,10 @@ if os.environ.get("PADDLE_TPU_FORCE_CPU"):
 
     jax.config.update("jax_platforms", "cpu")
 
+import itertools
+
 _HANDLES: dict = {}
-_NEXT = [1]
+_NEXT = itertools.count(1)  # atomic under the GIL
 
 
 def create(merged_path: str, output_layer: str = "") -> int:
@@ -37,8 +39,7 @@ def create(merged_path: str, output_layer: str = "") -> int:
     inf = Inferencer.from_merged(
         merged_path, outputs=[output_layer] if output_layer else None
     )
-    h = _NEXT[0]
-    _NEXT[0] += 1
+    h = next(_NEXT)
     _HANDLES[h] = inf
     return h
 

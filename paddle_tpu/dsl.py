@@ -416,6 +416,46 @@ def multibox_loss(priorbox_ref, gt_box, gt_label, loc_pred, conf_pred,
                 background_id=background_id)
 
 
+def dot_mul(a, b, name=None, act=""):
+    """Elementwise product of two same-size layers (DotMulOperator)."""
+    return _add("dot_mul", [a, b], name=name, bias=False, act=act)
+
+
+def slope_intercept(x, slope=1.0, intercept=0.0, name=None):
+    return _add("slope_intercept", [x], name=name, bias=False,
+                slope=slope, intercept=intercept)
+
+
+def interpolation(weight, a, b, name=None):
+    return _add("interpolation", [weight, a, b], name=name, bias=False)
+
+
+def soft_binary_cross_entropy(prob, label, name=None, coeff=1.0):
+    """Elementwise binary CE with soft labels (layers.py
+    cross_entropy_with_selfnorm family; CostLayer.cpp
+    SoftBinaryClassCrossEntropy)."""
+    return _add("soft_binary_class_cross_entropy", [prob, label],
+                name=name or "cost", bias=False, coeff=coeff)
+
+
+def sum_cost(x, name=None, coeff=1.0):
+    """(trainer_config_helpers sum_cost): cost = sum of the input."""
+    return _add("sum_cost", [x], name=name or "cost", bias=False,
+                coeff=coeff)
+
+
+def crf(emission, label, num_tags, name=None, param=None, coeff=1.0):
+    """(layers.py crf_layer)."""
+    return _add("crf", [emission, label], name=name or "cost", size=num_tags,
+                bias=False, param=param, coeff=coeff)
+
+
+def crf_decoding(emission, num_tags, label=None, name=None, param=None):
+    ins = [emission] if label is None else [emission, label]
+    return _add("crf_decoding", ins, name=name, size=num_tags, bias=False,
+                param=param)
+
+
 # ---- long-tail layers (layers/extras.py) ----
 
 def selective_fc(x, select=None, *, size, name=None, act="", bias=True,

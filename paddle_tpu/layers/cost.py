@@ -90,6 +90,17 @@ class SmoothL1Cost(CostLayerBase):
         return self._reduce(jnp.sum(per, axis=-1), x)
 
 
+@LAYERS.register("sum_cost")
+class SumCost(CostLayerBase):
+    """cost = sum over the input vector (trainer_config_helpers
+    sum_cost / SumCostLayer) — the raw-aggregation building block the
+    VAE demo uses for its KL term (v1_api_demo/vae/vae_conf.py:103)."""
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        return self._reduce(jnp.sum(x.value, axis=-1), x)
+
+
 @LAYERS.register("soft_binary_class_cross_entropy")
 class SoftBinaryCE(CostLayerBase):
     """Elementwise binary CE with soft labels (CostLayer.cpp)."""

@@ -8,5 +8,11 @@ from paddle_tpu.models.image import (  # noqa: F401
 )
 from paddle_tpu.models.text import (  # noqa: F401
     bidi_lstm_tagger,
+    linear_crf_tagger,
+    rnn_crf_tagger,
+    seq2seq_attention,
+    seq2seq_attention_decoder,
     stacked_lstm_classifier,
 )
+from paddle_tpu.models.gan import GAN, gan_conf  # noqa: F401
+from paddle_tpu.models.vae import vae_conf  # noqa: F401

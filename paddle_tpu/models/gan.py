@@ -1,0 +1,156 @@
+"""GAN demo (v1_api_demo/gan/gan_conf.py + gan_trainer.py).
+
+The reference builds one config per training mode and freezes the other
+half with is_static param attrs (gan_conf.py:51,94), alternating modes
+from the trainer. Same design here: generator and discriminator share
+parameters BY NAME across the two training configs; the config for each
+phase marks the other network's parameters is_static so its optimizer
+update is skipped (optimizers.Optimizer.update h.is_static). `GAN`
+wraps the two jitted train steps and the sample path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import dsl
+from paddle_tpu.core.arg import Arg, id_arg, non_seq
+from paddle_tpu.core.config import ModelConf, ParameterConf
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+
+def _p(name, static):
+    return ParameterConf(name=name, is_static=static)
+
+
+def _generator(noise, sample_dim, hidden, static):
+    h = dsl.fc(noise, size=hidden, act="relu", name="gen_h1",
+               param=_p("gen_w1", static))
+    h = dsl.fc(h, size=hidden, act="relu", name="gen_h2",
+               param=_p("gen_w2", static))
+    return dsl.fc(h, size=sample_dim, name="gen_out",
+                  param=_p("gen_w3", static))
+
+
+def _discriminator(sample, hidden, static):
+    h = dsl.fc(sample, size=hidden, act="relu", name="dis_h1",
+               param=_p("dis_w1", static))
+    h = dsl.fc(h, size=hidden, act="relu", name="dis_h2",
+               param=_p("dis_w2", static))
+    return dsl.fc(h, size=2, name="dis_out", param=_p("dis_w3", static))
+
+
+def gan_conf(mode: str, noise_dim=10, sample_dim=2, hidden=64) -> ModelConf:
+    """mode in {generator_training, discriminator_training, generator}
+    (gan_conf.py:16-24)."""
+    assert mode in (
+        "generator_training",
+        "discriminator_training",
+        "generator",
+    )
+    with dsl.model() as g:
+        if mode == "discriminator_training":
+            sample = dsl.data("sample", sample_dim)
+            label = dsl.data("label", 1, is_ids=True)
+            logits = _discriminator(sample, hidden, static=False)
+            dsl.classification_cost(logits, label, name="cost")
+        else:
+            noise = dsl.data("noise", noise_dim)
+            sample = _generator(
+                noise, sample_dim, hidden, static=(mode == "generator")
+            )
+            g.conf.output_layer_names.append("gen_out")
+            if mode == "generator_training":
+                label = dsl.data("label", 1, is_ids=True)
+                logits = _discriminator(sample, hidden, static=True)
+                dsl.classification_cost(logits, label, name="cost")
+    return g.conf
+
+
+class GAN:
+    """Alternating trainer (gan_trainer.py): d-step on real+fake
+    samples, g-step through the frozen discriminator. One parameter
+    dict is shared across phases — exactly the by-name sharing the
+    reference gets from its parameter server."""
+
+    def __init__(self, opt_conf, noise_dim=10, sample_dim=2, hidden=64,
+                 seed=0):
+        self.noise_dim = noise_dim
+        self.g_net = Network(
+            gan_conf("generator_training", noise_dim, sample_dim, hidden)
+        )
+        self.d_net = Network(
+            gan_conf("discriminator_training", noise_dim, sample_dim,
+                     hidden)
+        )
+        key = jax.random.key(seed)
+        kg, kd = jax.random.split(key)
+        # one shared dict: generator params from g_net init,
+        # discriminator params from d_net init
+        self.params = dict(self.g_net.init_params(kg))
+        self.params.update(self.d_net.init_params(kd))
+        self.g_opt = create_optimizer(opt_conf, self.g_net.param_confs)
+        self.d_opt = create_optimizer(opt_conf, self.d_net.param_confs)
+        self.g_opt_state = self.g_opt.init_state(self.params)
+        self.d_opt_state = self.d_opt.init_state(self.params)
+
+        def g_step(params, opt_state, noise, step_i):
+            feed = {
+                "noise": non_seq(noise),
+                # generator wants fakes scored as REAL (label 1)
+                "label": id_arg(
+                    jnp.ones(noise.shape[0], jnp.int32)
+                ),
+            }
+            (loss, _), grads = jax.value_and_grad(
+                self.g_net.loss_fn, has_aux=True
+            )(params, feed)
+            params, opt_state = self.g_opt.update(
+                grads, params, opt_state, step_i
+            )
+            return params, opt_state, loss
+
+        def d_step(params, opt_state, sample, label, step_i):
+            feed = {"sample": non_seq(sample), "label": id_arg(label)}
+            (loss, _), grads = jax.value_and_grad(
+                self.d_net.loss_fn, has_aux=True
+            )(params, feed)
+            params, opt_state = self.d_opt.update(
+                grads, params, opt_state, step_i
+            )
+            return params, opt_state, loss
+
+        def sample_fn(params, noise):
+            outs, _ = self.g_net.forward(
+                params, {"noise": non_seq(noise)}, outputs=["gen_out"]
+            )
+            return outs["gen_out"].value
+
+        self._g_step = jax.jit(g_step)
+        self._d_step = jax.jit(d_step)
+        self._sample = jax.jit(sample_fn)
+
+    def sample(self, noise):
+        return self._sample(self.params, noise)
+
+    def train_d(self, real, noise, step_i):
+        fake = self.sample(noise)
+        samples = jnp.concatenate([real, fake])
+        labels = jnp.concatenate(
+            [
+                jnp.ones(real.shape[0], jnp.int32),
+                jnp.zeros(fake.shape[0], jnp.int32),
+            ]
+        )
+        self.params, self.d_opt_state, loss = self._d_step(
+            self.params, self.d_opt_state, samples, labels, step_i
+        )
+        return float(loss)
+
+    def train_g(self, noise, step_i):
+        self.params, self.g_opt_state, loss = self._g_step(
+            self.params, self.g_opt_state, noise, step_i
+        )
+        return float(loss)

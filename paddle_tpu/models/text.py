@@ -53,6 +53,60 @@ def bidi_lstm_tagger(
     return g.conf
 
 
+def linear_crf_tagger(
+    vocab_size=5000,
+    num_tags=9,
+    emb_dim=32,
+    context_length=3,
+) -> ModelConf:
+    """Linear-chain CRF tagger (v1_api_demo/sequence_tagging/
+    linear_crf.py): context-window features -> fc emissions -> crf cost,
+    with crf_decoding sharing the "crfw" transition parameter for
+    prediction (linear_crf.py:59-69)."""
+    from paddle_tpu.core.config import ParameterConf
+
+    with dsl.model() as g:
+        ids = dsl.data("words", (1,), is_seq=True, is_ids=True)
+        tags = dsl.data("tags", (1,), is_seq=True, is_ids=True)
+        emb = dsl.embedding(ids, size=emb_dim, vocab_size=vocab_size)
+        feat = dsl.mixed(
+            emb_dim * context_length,
+            [dsl.context_projection(emb, context_length)],
+            name="ctx_feat", bias=False,
+        )
+        emission = dsl.fc(feat, size=num_tags, name="emission")
+        dsl.crf(emission, tags, num_tags=num_tags, name="crf_cost",
+                param=ParameterConf(name="crfw"))
+        dsl.crf_decoding(emission, num_tags=num_tags, name="decoded",
+                         param=ParameterConf(name="crfw"))
+        g.conf.output_layer_names.append("decoded")
+    return g.conf
+
+
+def rnn_crf_tagger(
+    vocab_size=5000,
+    num_tags=9,
+    emb_dim=32,
+    hidden=64,
+) -> ModelConf:
+    """Bidirectional-RNN + CRF tagger (v1_api_demo/sequence_tagging/
+    rnn_crf.py): the neural emission model under the same CRF head."""
+    from paddle_tpu.core.config import ParameterConf
+
+    with dsl.model() as g:
+        ids = dsl.data("words", (1,), is_seq=True, is_ids=True)
+        tags = dsl.data("tags", (1,), is_seq=True, is_ids=True)
+        emb = dsl.embedding(ids, size=emb_dim, vocab_size=vocab_size)
+        h = dsl.bidirectional_lstm(emb, hidden)
+        emission = dsl.fc(h, size=num_tags, name="emission")
+        dsl.crf(emission, tags, num_tags=num_tags, name="crf_cost",
+                param=ParameterConf(name="crfw"))
+        dsl.crf_decoding(emission, num_tags=num_tags, name="decoded",
+                         param=ParameterConf(name="crfw"))
+        g.conf.output_layer_names.append("decoded")
+    return g.conf
+
+
 def _attention_decoder_step(hidden, trg_vocab, emb_dim):
     """One decoder step: shared verbatim between the training
     recurrent_group and the generation BeamSearchDecoder so all parameter

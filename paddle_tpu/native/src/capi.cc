@@ -24,16 +24,23 @@ std::string g_error;
 bool g_we_initialized = false;
 PyThreadState* g_main_tstate = nullptr;
 
+std::mutex g_err_mu;  // guards g_error against cross-thread get/set
+
 void set_error(const char* what) {
-  g_error = what ? what : "unknown error";
+  std::string msg = what ? what : "unknown error";
   if (PyErr_Occurred()) {
     PyObject *type, *value, *tb;
     PyErr_Fetch(&type, &value, &tb);
     if (value) {
       PyObject* s = PyObject_Str(value);
       if (s) {
-        g_error += ": ";
-        g_error += PyUnicode_AsUTF8(s);
+        const char* c = PyUnicode_AsUTF8(s);
+        if (c) {
+          msg += ": ";
+          msg += c;
+        } else {
+          PyErr_Clear();
+        }
         Py_DECREF(s);
       }
     }
@@ -41,6 +48,8 @@ void set_error(const char* what) {
     Py_XDECREF(value);
     Py_XDECREF(tb);
   }
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  g_error = std::move(msg);
 }
 
 PyObject* bridge() {
@@ -179,6 +188,14 @@ void pt_capi_destroy(int64_t handle) {
   Py_XDECREF(r);
 }
 
-const char* pt_capi_error() { return g_error.c_str(); }
+// Copies the last error into a thread-local buffer so the returned
+// pointer stays valid on this thread even if another thread sets a new
+// error concurrently.
+const char* pt_capi_error() {
+  static thread_local std::string local;
+  std::lock_guard<std::mutex> lock(g_err_mu);
+  local = g_error;
+  return local.c_str();
+}
 
 }  // extern "C"

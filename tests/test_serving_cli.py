@@ -114,6 +114,23 @@ class TestCLI:
         assert "output" in out
 
 
+class TestInferExampleSeq:
+    def test_infer_example_on_sequence_model(self, tmp_path, capsys):
+        # merged model with is_seq data inputs: the smoke feed must add
+        # a time dimension and seq_lens
+        from paddle_tpu.models.text import linear_crf_tagger
+
+        conf = linear_crf_tagger(vocab_size=20, num_tags=4, emb_dim=8)
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        merged = str(tmp_path / "crf.npz")
+        ckpt.merge_model(merged, conf, params)
+        assert cli.main(["infer", "--model", merged, "--example",
+                         "--batch", "2"]) == 0
+        out = capsys.readouterr().out
+        assert "decoded" in out
+
+
 class TestInferenceAPI:
     def test_infer_one_shot(self, tmp_path):
         merged, net, params = _merged_model(tmp_path)
