@@ -1,0 +1,124 @@
+"""Declarative data providers — the @provider decorator.
+
+Reference: python/paddle/trainer/PyDataProvider2.py:329 (@provider with
+input_types, cache modes, should_shuffle, init_hook, calc_batch_size)
+driving the C++ PyDataProvider2 (gserver/dataproviders/
+PyDataProvider2.cpp:70-235). Here the provider is a plain reader
+factory: `process(file_list)` returns a reader over all files, with the
+same per-pass in-memory cache and shuffle semantics; input types come
+from data.feeder and the resulting samples feed DataFeeder directly.
+"""
+
+from __future__ import annotations
+
+import random as _random
+from typing import Callable, List, Optional, Sequence
+
+
+class CacheType:
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1  # cache samples after the first pass
+
+
+class _Settings:
+    """Mutable bag passed to init_hook and the process function
+    (PyDataProvider2.py settings object): carries input_types plus
+    whatever init_hook attaches (dictionaries, vocab sizes, ...)."""
+
+    def __init__(self, input_types, kwargs):
+        self.input_types = input_types
+        self.logger = __import__("logging").getLogger("paddle_tpu.data")
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class DataProvider:
+    def __init__(
+        self,
+        fn: Callable,
+        input_types,
+        should_shuffle: Optional[bool] = None,
+        cache: int = CacheType.NO_CACHE,
+        init_hook: Optional[Callable] = None,
+        **kwargs,
+    ):
+        self.fn = fn
+        self.input_types = input_types
+        self.should_shuffle = should_shuffle
+        self.cache = cache
+        self.init_hook = init_hook
+        self.kwargs = kwargs
+        # per-file-list cache: one decorated fn commonly serves both a
+        # train and a test reader (PyDataProvider2 caches per provider
+        # instance, which the C++ side creates per data source)
+        self._cache_store: dict = {}
+
+    def __call__(self, file_list, **hook_kwargs) -> Callable:
+        """Returns a reader creator over `file_list` (a path or list)."""
+        if isinstance(file_list, str):
+            file_list = [file_list]
+        settings = _Settings(self.input_types, self.kwargs)
+        if self.init_hook is not None:
+            self.init_hook(settings, file_list=file_list, **hook_kwargs)
+        shuffle = (
+            self.should_shuffle
+            if self.should_shuffle is not None
+            else True
+        )
+
+        cache_key = tuple(file_list)
+        pass_counter = [0]
+
+        def reader():
+            if (
+                self.cache == CacheType.CACHE_PASS_IN_MEM
+                and cache_key in self._cache_store
+            ):
+                samples = list(self._cache_store[cache_key])
+            else:
+                samples = []
+                for path in file_list:
+                    for sample in self.fn(settings, path):
+                        samples.append(sample)
+                if self.cache == CacheType.CACHE_PASS_IN_MEM:
+                    self._cache_store[cache_key] = list(samples)
+            if shuffle:
+                # deterministic but DIFFERENT order each pass (the
+                # reference reshuffles per pass)
+                _random.Random(0xC0FFEE + pass_counter[0]).shuffle(
+                    samples
+                )
+                pass_counter[0] += 1
+            yield from samples
+
+        return reader
+
+
+def provider(
+    input_types=None,
+    should_shuffle=None,
+    cache: int = CacheType.NO_CACHE,
+    init_hook: Optional[Callable] = None,
+    **kwargs,
+):
+    """Decorator (PyDataProvider2.py:329):
+
+        @provider(input_types=[dense_vector(784), integer_value(10)],
+                  cache=CacheType.CACHE_PASS_IN_MEM)
+        def process(settings, filename):
+            for img, lbl in read(filename):
+                yield img, lbl
+    """
+    assert input_types is not None, "provider needs input_types"
+
+    def deco(fn):
+        return DataProvider(
+            fn,
+            input_types,
+            should_shuffle=should_shuffle,
+            cache=cache,
+            init_hook=init_hook,
+            **kwargs,
+        )
+
+    return deco

@@ -115,9 +115,10 @@ def data(name, dim, is_seq=False, is_ids=False, has_subseq=False):
 
 # ---- dense / basic ----
 
-def fc(*inputs, size, name=None, act="", bias=True, param=None, drop_rate=0.0):
+def fc(*inputs, size, name=None, act="", bias=True, param=None,
+       bias_param=None, drop_rate=0.0):
     return _add("fc", inputs, name=name, size=size, act=act, bias=bias,
-                param=param, drop_rate=drop_rate)
+                param=param, bias_param=bias_param, drop_rate=drop_rate)
 
 
 def embedding(ids, size, vocab_size, name=None, param=None, sharded=False):

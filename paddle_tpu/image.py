@@ -1,0 +1,113 @@
+"""Image preprocessing helpers.
+
+Reference: python/paddle/v2/image.py — load/resize_short/to_chw/
+center_crop/random_crop/left_right_flip/simple_transform/
+load_and_transform, all returning numpy HWC uint8 (until to_chw).
+PIL-based here (the reference uses cv2)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "load_image",
+    "load_image_bytes",
+    "resize_short",
+    "to_chw",
+    "center_crop",
+    "random_crop",
+    "left_right_flip",
+    "simple_transform",
+    "load_and_transform",
+]
+
+
+def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(data))
+    im = im.convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def load_image(path: str, is_color: bool = True) -> np.ndarray:
+    from PIL import Image
+
+    im = Image.open(path)
+    im = im.convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the SHORT side equals `size` (image.py:143)."""
+    from PIL import Image
+
+    h, w = im.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(round(h * size / w))
+    else:
+        new_w, new_h = int(round(w * size / h)), size
+    pil = Image.fromarray(im)
+    return np.asarray(pil.resize((new_w, new_h), Image.BILINEAR))
+
+
+def to_chw(im: np.ndarray, order=(2, 0, 1)) -> np.ndarray:
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im: np.ndarray, size: int, is_color: bool = True):
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0 : h0 + size, w0 : w0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, is_color: bool = True,
+                rng=None):
+    rng = rng or np.random.default_rng()
+    h, w = im.shape[:2]
+    h0 = int(rng.integers(0, h - size + 1))
+    w0 = int(rng.integers(0, w - size + 1))
+    return im[h0 : h0 + size, w0 : w0 + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return im[:, ::-1]
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, is_color: bool = True, mean=None,
+                     rng=None) -> np.ndarray:
+    """resize-short -> crop (random+flip when training, center else) ->
+    CHW float32 -> optional mean subtract (image.py:265)."""
+    rng = rng or np.random.default_rng()
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if rng.integers(0, 2) == 0:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if len(im.shape) == 3:
+        im = to_chw(im)
+    im = im.astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:
+            if im.ndim == 2:  # grayscale: collapse per-channel mean
+                mean = mean.mean()
+            else:
+                mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename: str, resize_size: int, crop_size: int,
+                       is_train: bool, is_color: bool = True, mean=None):
+    return simple_transform(
+        load_image(filename, is_color), resize_size, crop_size, is_train,
+        is_color, mean,
+    )

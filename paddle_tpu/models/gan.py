@@ -25,21 +25,24 @@ def _p(name, static):
     return ParameterConf(name=name, is_static=static)
 
 
+def _fc(x, size, act, name, pname, static):
+    # weight AND bias carry is_static — the reference freezes whole
+    # layers via ParamAttr+bias_attr (gan_conf.py:51-53)
+    return dsl.fc(x, size=size, act=act, name=name,
+                  param=_p(pname, static),
+                  bias_param=_p(pname + "_b", static))
+
+
 def _generator(noise, sample_dim, hidden, static):
-    h = dsl.fc(noise, size=hidden, act="relu", name="gen_h1",
-               param=_p("gen_w1", static))
-    h = dsl.fc(h, size=hidden, act="relu", name="gen_h2",
-               param=_p("gen_w2", static))
-    return dsl.fc(h, size=sample_dim, name="gen_out",
-                  param=_p("gen_w3", static))
+    h = _fc(noise, hidden, "relu", "gen_h1", "gen_w1", static)
+    h = _fc(h, hidden, "relu", "gen_h2", "gen_w2", static)
+    return _fc(h, sample_dim, "", "gen_out", "gen_w3", static)
 
 
 def _discriminator(sample, hidden, static):
-    h = dsl.fc(sample, size=hidden, act="relu", name="dis_h1",
-               param=_p("dis_w1", static))
-    h = dsl.fc(h, size=hidden, act="relu", name="dis_h2",
-               param=_p("dis_w2", static))
-    return dsl.fc(h, size=2, name="dis_out", param=_p("dis_w3", static))
+    h = _fc(sample, hidden, "relu", "dis_h1", "dis_w1", static)
+    h = _fc(h, hidden, "relu", "dis_h2", "dis_w2", static)
+    return _fc(h, 2, "", "dis_out", "dis_w3", static)
 
 
 def gan_conf(mode: str, noise_dim=10, sample_dim=2, hidden=64) -> ModelConf:

@@ -38,11 +38,12 @@ void set_error(const char* what) {
         if (c) {
           msg += ": ";
           msg += c;
-        } else {
-          PyErr_Clear();
         }
         Py_DECREF(s);
       }
+      // PyObject_Str or PyUnicode_AsUTF8 may have set a NEW exception;
+      // never leave it pending for the next bridge call
+      PyErr_Clear();
     }
     Py_XDECREF(type);
     Py_XDECREF(value);

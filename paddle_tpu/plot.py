@@ -1,0 +1,66 @@
+"""Training-curve plotting helper.
+
+Reference: python/paddle/v2/plot/plot.py (Ploter with per-title
+PlotData, append/plot/reset; falls back to text output when matplotlib
+or a display is unavailable — the DISABLE_PLOT env toggle)."""
+
+from __future__ import annotations
+
+import os
+
+
+class PlotData:
+    def __init__(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+
+class Ploter:
+    def __init__(self, *titles):
+        self.__args__ = titles
+        self.__plot_data__ = {t: PlotData() for t in titles}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "")
+
+    def __plot_is_disabled__(self):
+        return self.__disable_plot__ == "True"
+
+    def append(self, title, step, value):
+        assert title in self.__plot_data__, f"unknown title {title!r}"
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path: str = None):
+        """Render to `path` (PNG) with matplotlib when available,
+        else print the latest values."""
+        if self.__plot_is_disabled__():
+            return
+        try:
+            import matplotlib
+
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+
+            fig, ax = plt.subplots()
+            for title in self.__args__:
+                d = self.__plot_data__[title]
+                ax.plot(d.step, d.value, label=title)
+            ax.legend()
+            if path:
+                fig.savefig(path)
+            plt.close(fig)
+        except Exception:
+            for title in self.__args__:
+                d = self.__plot_data__[title]
+                if d.step:
+                    print(f"{title}: step {d.step[-1]} = {d.value[-1]}")
+
+    def reset(self):
+        for d in self.__plot_data__.values():
+            d.reset()

@@ -27,6 +27,11 @@ class TestGAN:
             if n.startswith("dis_"):
                 assert g.param_confs[n].is_static
                 assert not d.param_confs[n].is_static
+        # EVERY discriminator-side parameter (biases included) must be
+        # frozen during generator training, else g-steps corrupt d
+        for n, pc in g.param_confs.items():
+            if "dis" in n:
+                assert pc.is_static, n
 
     def test_gan_learns_2d_gaussian(self):
         gan = GAN(

@@ -1,0 +1,174 @@
+"""@provider decorator, Ploter, image utils (reference:
+python/paddle/trainer/PyDataProvider2.py, python/paddle/v2/plot/,
+python/paddle/v2/image.py)."""
+
+import numpy as np
+
+from paddle_tpu import image as pimg
+from paddle_tpu.data.feeder import (
+    DataFeeder,
+    dense_vector,
+    integer_value,
+)
+from paddle_tpu.data.provider import CacheType, provider
+from paddle_tpu.plot import Ploter
+
+
+class TestProvider:
+    def _make(self, cache=CacheType.NO_CACHE, **kw):
+        calls = []
+
+        @provider(
+            input_types=[dense_vector(4), integer_value(3)],
+            cache=cache,
+            should_shuffle=False,
+            **kw,
+        )
+        def process(settings, filename):
+            calls.append(filename)
+            for i in range(5):
+                yield np.full(4, i, np.float32), i % 3
+
+        return process, calls
+
+    def test_reads_all_files(self):
+        process, calls = self._make()
+        rd = process(["a.txt", "b.txt"])
+        samples = list(rd())
+        assert len(samples) == 10
+        assert calls == ["a.txt", "b.txt"]
+        v, l = samples[0]
+        assert v.shape == (4,) and l in (0, 1, 2)
+
+    def test_cache_pass_in_mem(self):
+        process, calls = self._make(cache=CacheType.CACHE_PASS_IN_MEM)
+        rd = process("x.txt")
+        assert len(list(rd())) == 5
+        assert len(list(rd())) == 5  # second pass from cache
+        assert calls == ["x.txt"]  # generator ran once
+
+    def test_init_hook_settings(self):
+        seen = {}
+
+        def hook(settings, file_list, **kw):
+            settings.vocab = {"a": 0}
+            seen["files"] = file_list
+
+        @provider(
+            input_types=[integer_value(10)], init_hook=hook,
+            should_shuffle=False,
+        )
+        def process(settings, filename):
+            assert settings.vocab == {"a": 0}
+            yield (1,)
+
+        assert list(process("f")()) == [(1,)]
+        assert seen["files"] == ["f"]
+
+    def test_shuffle_is_deterministic(self):
+        @provider(input_types=[integer_value(100)])
+        def process(settings, filename):
+            for i in range(20):
+                yield (i,)
+
+        a = list(process("f")())
+        b = list(process("f")())
+        assert a == b and a != [(i,) for i in range(20)]
+
+    def test_cache_is_per_file_list(self):
+        process, calls = self._make(cache=CacheType.CACHE_PASS_IN_MEM)
+        train = process("train.txt")
+        test = process("test.txt")
+        list(train())
+        list(test())
+        assert calls == ["train.txt", "test.txt"]  # no cross-serving
+
+    def test_reshuffles_each_pass(self):
+        @provider(input_types=[integer_value(100)])
+        def process(settings, filename):
+            for i in range(20):
+                yield (i,)
+
+        rd = process("f")
+        assert list(rd()) != list(rd())  # per-pass reshuffle
+
+    def test_gray_mean_subtract(self):
+        gray = np.random.default_rng(0).integers(
+            0, 255, (40, 60), dtype=np.uint8
+        )
+        out = pimg.simple_transform(
+            gray, 32, 24, is_train=False, is_color=False,
+            mean=[1.0, 2.0, 3.0],
+        )
+        assert out.shape == (24, 24)
+
+    def test_feeds_data_feeder(self):
+        process, _ = self._make()
+        feeder = DataFeeder(
+            feeding={"x": 0, "y": 1},
+            types={"x": dense_vector(4), "y": integer_value(3)},
+        )
+        batch = list(process("f")())
+        feed = feeder(batch)
+        assert feed["x"].value.shape == (5, 4)
+        assert feed["y"].ids.shape == (5,)
+
+
+class TestPloter:
+    def test_append_and_plot(self, tmp_path):
+        p = Ploter("train_cost", "test_cost")
+        for i in range(5):
+            p.append("train_cost", i, 1.0 / (i + 1))
+        p.append("test_cost", 0, 0.5)
+        out = str(tmp_path / "curve.png")
+        p.plot(out)
+        import os
+
+        assert os.path.exists(out)
+        p.reset()
+        assert p.__plot_data__["train_cost"].step == []
+
+    def test_unknown_title(self):
+        p = Ploter("a")
+        try:
+            p.append("b", 0, 1.0)
+            raise RuntimeError("should have raised")
+        except AssertionError:
+            pass
+
+
+class TestImage:
+    def _im(self, h=40, w=60):
+        rng = np.random.default_rng(0)
+        return rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+
+    def test_resize_short(self):
+        im = pimg.resize_short(self._im(), 20)
+        assert min(im.shape[:2]) == 20
+        assert im.shape[1] == 30  # aspect preserved
+
+    def test_crops_and_flip(self):
+        im = self._im()
+        c = pimg.center_crop(im, 16)
+        assert c.shape == (16, 16, 3)
+        r = pimg.random_crop(im, 16, rng=np.random.default_rng(1))
+        assert r.shape == (16, 16, 3)
+        f = pimg.left_right_flip(im)
+        np.testing.assert_array_equal(f[:, 0], im[:, -1])
+
+    def test_simple_transform(self):
+        out = pimg.simple_transform(
+            self._im(), 32, 24, is_train=False,
+            mean=[1.0, 2.0, 3.0],
+        )
+        assert out.shape == (3, 24, 24) and out.dtype == np.float32
+
+    def test_load_roundtrip(self, tmp_path):
+        from PIL import Image
+
+        p = str(tmp_path / "t.png")
+        Image.fromarray(self._im()).save(p)
+        im = pimg.load_image(p)
+        assert im.shape == (40, 60, 3)
+        chw = pimg.load_and_transform(p, 32, 24, is_train=True)
+        assert chw.shape == (3, 24, 24)
