@@ -18,6 +18,13 @@ BASELINE_ALEXNET_BS128_MS = 334.0
 def main():
     import jax
 
+    from paddle_tpu.core import flags as _flags
+
+    # mixed precision: float32 master params, bfloat16 compute
+    # (paddle_tpu/network.py AMP policy) — the TPU-native equivalent of
+    # the reference's fastest path
+    _flags.set_flag("matmul_precision", "bfloat16")
+
     from paddle_tpu.core.arg import id_arg, non_seq
     from paddle_tpu.core.config import OptimizationConf
     from paddle_tpu.models import alexnet

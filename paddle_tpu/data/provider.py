@@ -66,21 +66,30 @@ class DataProvider:
             else True
         )
 
-        cache_key = tuple(file_list)
+        # key includes the hook kwargs: the same files can legitimately
+        # be re-read under different init_hook settings (e.g. another
+        # vocabulary) and must not serve stale samples
+        cache_key = (
+            tuple(file_list),
+            repr(sorted(hook_kwargs.items())),
+        )
         pass_counter = [0]
+        use_cache = self.cache == CacheType.CACHE_PASS_IN_MEM
+
+        def generate():
+            for path in file_list:
+                yield from self.fn(settings, path)
 
         def reader():
-            if (
-                self.cache == CacheType.CACHE_PASS_IN_MEM
-                and cache_key in self._cache_store
-            ):
+            if not use_cache and not shuffle:
+                # stream: larger-than-RAM datasets in O(1) memory
+                yield from generate()
+                return
+            if use_cache and cache_key in self._cache_store:
                 samples = list(self._cache_store[cache_key])
             else:
-                samples = []
-                for path in file_list:
-                    for sample in self.fn(settings, path):
-                        samples.append(sample)
-                if self.cache == CacheType.CACHE_PASS_IN_MEM:
+                samples = list(generate())
+                if use_cache:
                     self._cache_store[cache_key] = list(samples)
             if shuffle:
                 # deterministic but DIFFERENT order each pass (the

@@ -72,7 +72,11 @@ class ConvLayer(Layer):
             rhs_dilation=(dh, dw),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=groups,
-            preferred_element_type=jnp.float32,
+            # float32 accumulation for float32 inputs; bf16 (AMP) inputs
+            # keep bf16 outputs so activations stay half-width in HBM
+            preferred_element_type=(
+                None if x.dtype == jnp.bfloat16 else jnp.float32
+            ),
         )
         if "b" in params:
             y = y + params["b"]
@@ -119,7 +123,11 @@ class ConvTransLayer(Layer):
             padding=((fh - 1 - ph, fh - 1 - ph), (fw - 1 - pw, fw - 1 - pw)),
             lhs_dilation=(sh, sw),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32,
+            # float32 accumulation for float32 inputs; bf16 (AMP) inputs
+            # keep bf16 outputs so activations stay half-width in HBM
+            preferred_element_type=(
+                None if x.dtype == jnp.bfloat16 else jnp.float32
+            ),
         )
         if "b" in params:
             y = y + params["b"]

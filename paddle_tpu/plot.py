@@ -36,30 +36,42 @@ class Ploter:
         assert title in self.__plot_data__, f"unknown title {title!r}"
         self.__plot_data__[title].append(step, value)
 
+    def _print_latest(self):
+        for title in self.__args__:
+            d = self.__plot_data__[title]
+            if d.step:
+                print(f"{title}: step {d.step[-1]} = {d.value[-1]}")
+
     def plot(self, path: str = None):
-        """Render to `path` (PNG) with matplotlib when available,
-        else print the latest values."""
+        """Render to `path` (PNG) with matplotlib; with no path, show
+        the figure when a GUI backend is available, else print the
+        latest values. Text output only when matplotlib itself is
+        missing — save errors (bad path, full disk) propagate."""
         if self.__plot_is_disabled__():
             return
         try:
             import matplotlib
 
-            matplotlib.use("Agg")
+            if path:
+                matplotlib.use("Agg")
             import matplotlib.pyplot as plt
-
-            fig, ax = plt.subplots()
+        except Exception:
+            self._print_latest()
+            return
+        fig, ax = plt.subplots()
+        try:
             for title in self.__args__:
                 d = self.__plot_data__[title]
                 ax.plot(d.step, d.value, label=title)
             ax.legend()
             if path:
                 fig.savefig(path)
+            elif matplotlib.get_backend().lower() == "agg":
+                self._print_latest()  # headless: nothing to show
+            else:
+                plt.show()
+        finally:
             plt.close(fig)
-        except Exception:
-            for title in self.__args__:
-                d = self.__plot_data__[title]
-                if d.step:
-                    print(f"{title}: step {d.step[-1]} = {d.value[-1]}")
 
     def reset(self):
         for d in self.__plot_data__.values():

@@ -1,0 +1,116 @@
+"""Mixed-precision (bfloat16 compute / float32 master params) policy
+tests — paddle_tpu/network.py AMP via flags matmul_precision."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import dsl
+from paddle_tpu.core import flags as F
+from paddle_tpu.core.arg import id_arg, non_seq
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+
+@pytest.fixture
+def amp_flag():
+    F.set_flag("matmul_precision", "bfloat16")
+    yield
+    F.set_flag("matmul_precision", "default")
+
+
+def _conv_net():
+    with dsl.model() as g:
+        x = dsl.data("img", (8, 8, 3))
+        y = dsl.data("y", 1, is_ids=True)
+        h = dsl.conv(x, 8, 3, padding=1, act="relu")
+        h = dsl.pool(h, 2, 2)
+        out = dsl.fc(h, size=4, name="logits")
+        dsl.classification_cost(out, y, name="cost")
+        g.conf.output_layer_names.append("logits")
+    return g.conf
+
+
+def _batch(rng, B=16):
+    img = rng.standard_normal((B, 8, 8, 3)).astype(np.float32)
+    lab = (img.mean((1, 2, 3)) > 0).astype(np.int32) + 2 * (
+        img[:, :4].mean((1, 2, 3)) > 0
+    ).astype(np.int32)
+    return img, lab
+
+
+def test_amp_trains_and_keeps_fp32_masters(amp_flag):
+    conf = _conv_net()
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(
+        OptimizationConf(learning_method="adam", learning_rate=0.01),
+        net.param_confs,
+    )
+    st = opt.init_state(params)
+    rng = np.random.default_rng(0)
+    img, lab = _batch(rng)
+    feed = {"img": non_seq(jnp.asarray(img)), "y": id_arg(jnp.asarray(lab))}
+
+    @jax.jit
+    def step(params, st, i):
+        (l, _), g = jax.value_and_grad(net.loss_fn, has_aux=True)(
+            params, feed
+        )
+        params, st = opt.update(g, params, st, i)
+        return params, st, l
+
+    first = None
+    for i in range(40):
+        params, st, loss = step(params, st, i)
+        if i == 0:
+            first = float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
+    # master weights remain float32 throughout
+    for k, v in params.items():
+        assert v.dtype == jnp.float32, (k, v.dtype)
+    # activations inside the net are bfloat16; loss is float32
+    outs, _ = net.forward(params, feed, outputs=["logits"])
+    assert outs["logits"].value.dtype == jnp.bfloat16
+    assert jnp.asarray(net.loss_fn(params, feed)[0]).dtype == jnp.float32
+
+
+def test_amp_keeps_regression_targets_fp32(amp_flag):
+    # targets consumed only by a cost layer must NOT round-trip through
+    # bf16 (1000.3 would quantize to 1000)
+    with dsl.model() as g:
+        x = dsl.data("x", 4)
+        t = dsl.data("t", 1)
+        out = dsl.fc(x, size=1, name="pred")
+        dsl.square_error(out, t, name="cost")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    feed = {
+        "x": non_seq(jnp.ones((2, 4))),
+        "t": non_seq(jnp.full((2, 1), 1000.3, jnp.float32)),
+    }
+    loss, (outs, _) = net.loss_fn(params, feed)
+    pred = jnp.asarray(outs["pred"].value, jnp.float32)
+    want = float(jnp.mean(0.5 * (pred[:, 0] - 1000.3) ** 2))
+    got = float(loss)
+    # identical up to bf16 rounding of the PREDICTION only; a bf16
+    # target would shift the optimum by ~0.3
+    assert abs(got - want) / want < 1e-3, (got, want)
+
+
+def test_amp_matches_fp32_closely():
+    conf = _conv_net()
+    net = Network(conf)
+    params = net.init_params(jax.random.key(1))
+    rng = np.random.default_rng(2)
+    img, lab = _batch(rng)
+    feed = {"img": non_seq(jnp.asarray(img)), "y": id_arg(jnp.asarray(lab))}
+    l32 = float(net.loss_fn(params, feed)[0])
+    F.set_flag("matmul_precision", "bfloat16")
+    try:
+        l16 = float(net.loss_fn(params, feed)[0])
+    finally:
+        F.set_flag("matmul_precision", "default")
+    assert abs(l32 - l16) / max(abs(l32), 1e-6) < 0.05, (l32, l16)
