@@ -1,0 +1,207 @@
+"""Compatibility surface mirroring the SWIG `swig_paddle` module.
+
+Reference: paddle/api/PaddleAPI.h:103,244,402 and paddle/py_paddle —
+Matrix/Vector/IVector with numpy zero-copy (api/Paddle.i:142-165),
+Arguments, GradientMachine (createFromConfigProto, forward/backward),
+ParameterUpdater, SequenceGenerator (api/SequenceGenerator.cpp). Our
+native runtime IS Python+jax, so these are thin views over Network /
+optimizers / BeamSearchDecoder, kept for users porting v1-era scripts;
+new code should use those modules directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import ModelConf, OptimizationConf
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+__all__ = [
+    "Matrix",
+    "IVector",
+    "Arguments",
+    "GradientMachine",
+    "ParameterUpdater",
+    "SequenceGenerator",
+]
+
+
+class Matrix:
+    """Dense float matrix with numpy round-trip (api/Matrix.cpp;
+    createDenseFromNumpy / toNumpyMat)."""
+
+    def __init__(self, array):
+        self._a = np.asarray(array, np.float32)
+        assert self._a.ndim == 2, "Matrix is 2-D"
+
+    @classmethod
+    def createDenseFromNumpy(cls, a):
+        return cls(a)
+
+    def toNumpyMat(self) -> np.ndarray:
+        return self._a
+
+    def getHeight(self):
+        return self._a.shape[0]
+
+    def getWidth(self):
+        return self._a.shape[1]
+
+
+class IVector:
+    """Integer id vector (api/Vector.cpp)."""
+
+    def __init__(self, array):
+        self._a = np.asarray(array, np.int32).reshape(-1)
+
+    @classmethod
+    def createVectorFromNumpy(cls, a):
+        return cls(a)
+
+    def toNumpyArray(self) -> np.ndarray:
+        return self._a
+
+
+class Arguments:
+    """Slot-indexed value/id holder (api/Arguments.cpp; the Argument
+    bridging used by py_paddle.dataprovider_converter)."""
+
+    def __init__(self, n_slots: int = 0):
+        self._args = [Arg() for _ in range(n_slots)]
+
+    @classmethod
+    def createArguments(cls, n):
+        return cls(n)
+
+    def getSlotNum(self):
+        return len(self._args)
+
+    def setSlotValue(self, i: int, m: Matrix):
+        import dataclasses
+
+        self._args[i] = dataclasses.replace(
+            self._args[i], value=jax.numpy.asarray(m.toNumpyMat())
+        )
+
+    def setSlotIds(self, i: int, v: IVector):
+        import dataclasses
+
+        self._args[i] = dataclasses.replace(
+            self._args[i], ids=jax.numpy.asarray(v.toNumpyArray())
+        )
+
+    def getSlotValue(self, i: int) -> Matrix:
+        return Matrix(np.asarray(self._args[i].value))
+
+    def getSlotIds(self, i: int) -> IVector:
+        return IVector(np.asarray(self._args[i].ids))
+
+    def slots(self):
+        return self._args
+
+
+class GradientMachine:
+    """Stateful wrapper over Network — createFromConfigProto +
+    forward/backward/forwardBackward (api/GradientMachine.cpp;
+    GradientMachine.h:72). Holds mutable params the way the SWIG object
+    owned its Parameters."""
+
+    def __init__(self, conf: ModelConf, seed: int = 0):
+        self.net = Network(conf)
+        self.params = self.net.init_params(jax.random.key(seed))
+        self.state = self.net.init_state()
+        self._grads = None
+
+    @classmethod
+    def createFromConfigProto(cls, conf: ModelConf) -> "GradientMachine":
+        return cls(conf)
+
+    def getParameterNames(self):
+        return sorted(self.params)
+
+    def getParameter(self, name: str) -> np.ndarray:
+        return np.asarray(self.params[name])
+
+    def setParameter(self, name: str, value) -> None:
+        self.params[name] = jax.numpy.asarray(value)
+
+    def forward(self, feed: dict, outputs=None) -> dict:
+        outs, self.state = self.net.forward(
+            self.params, feed, state=self.state, train=False,
+            outputs=outputs,
+        )
+        return outs
+
+    def forwardBackward(self, feed: dict, rng=None):
+        """Returns the scalar cost; gradients retrievable via
+        getGradient (the UpdateCallback analogue)."""
+        (loss, (outs, new_state)), grads = jax.value_and_grad(
+            self.net.loss_fn, has_aux=True
+        )(self.params, feed, state=self.state, rng=rng)
+        self.state = new_state
+        self._grads = grads
+        return float(loss), outs
+
+    def getGradient(self, name: str) -> np.ndarray:
+        assert self._grads is not None, "call forwardBackward first"
+        return np.asarray(self._grads[name])
+
+
+class ParameterUpdater:
+    """Local updater (api/ParameterUpdater.cpp createLocalUpdater):
+    applies the configured optimizer to a GradientMachine's params."""
+
+    def __init__(self, opt_conf: OptimizationConf, gm: GradientMachine):
+        self.gm = gm
+        self.opt = create_optimizer(opt_conf, gm.net.param_confs)
+        self.opt_state = self.opt.init_state(gm.params)
+        self.step = 0
+
+    @classmethod
+    def createLocalUpdater(cls, opt_conf, gm):
+        return cls(opt_conf, gm)
+
+    def update(self) -> None:
+        assert self.gm._grads is not None, "no gradients pending"
+        self.gm.params, self.opt_state = self.opt.update(
+            self.gm._grads, self.gm.params, self.opt_state, self.step
+        )
+        self.gm._grads = None
+        self.step += 1
+
+
+class SequenceGenerator:
+    """Beam-search generation front-end (api/SequenceGenerator.cpp):
+    wraps BeamSearchDecoder, returning id sequences per input."""
+
+    def __init__(self, decoder, params: dict, dict_list=None):
+        self.decoder = decoder
+        self.params = params
+        self.dict_list = dict_list
+
+    def setBeamSize(self, k: int):
+        self.decoder.k = k
+
+    def generate(self, statics: Sequence[Arg], boots=None):
+        seqs, lens, scores = self.decoder.generate(
+            self.params, list(statics), boots=boots
+        )
+        seqs, lens = np.asarray(seqs), np.asarray(lens)
+        out = []
+        for b in range(seqs.shape[0]):
+            beams = []
+            for k in range(seqs.shape[1]):
+                ids = seqs[b, k, : lens[b, k]].tolist()
+                if self.dict_list is not None:
+                    beams.append(
+                        " ".join(self.dict_list[i] for i in ids)
+                    )
+                else:
+                    beams.append(ids)
+            out.append(beams)
+        return out

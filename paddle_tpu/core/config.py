@@ -36,6 +36,9 @@ class ParameterConf:
     sparse_update: bool = False  # row-sparse gradient (embeddings)
     sparse_remote_update: bool = False  # sharded-across-mesh table
     gradient_clipping_threshold: float = 0.0
+    # static pruning hook (ParameterUpdaterHook.cpp:39): fraction of
+    # weights zero-masked by initial magnitude; None = no pruning
+    sparsity_ratio: Optional[float] = None
 
     def to_dict(self):
         d = dataclasses.asdict(self)
@@ -141,6 +144,7 @@ class OptimizationConf:
     average_window: float = 0.0
     max_average_window: int = 0
     num_batches_per_send_parameter: int = 1
+    batches_per_pass: int = 0  # for pass_manual LR scheduling
 
 
 @dataclass

@@ -19,6 +19,9 @@ _DEFAULTS: dict[str, Any] = {
     "show_parameter_stats_period": 0,
     "test_period": 0,
     "seed": 0,  # 0 = nondeterministic seed from OS entropy
+    # FP-exception trap (reference enables feenableexcept at trainer
+    # start, trainer/TrainerMain.cpp:49): aborts on NaN-producing ops
+    "trap_fp": False,
     "save_dir": None,
     "saving_period": 1,
     "save_only_one": False,

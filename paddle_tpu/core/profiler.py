@@ -1,0 +1,54 @@
+"""Profiling hooks.
+
+Reference: compile-gated REGISTER_TIMER stats (utils/Stat.h:63,244 — our
+core/stat.py) plus GPU profiler start/stop around nvprof capture
+(cuda/include/hl_cuda.h:338-343, math/tests/test_GpuProfiler.cpp). TPU
+equivalent: the JAX/XLA profiler writing XPlane traces viewable in
+TensorBoard/xprof, with named scopes instead of REGISTER_TIMER macros.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["start", "stop", "trace", "scope", "annotate_fn"]
+
+
+def start(log_dir: str) -> None:
+    """Begin an XPlane trace capture (hl_profiler_start analogue)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop() -> None:
+    """End the capture (hl_profiler_end analogue)."""
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    start(log_dir)
+    try:
+        yield
+    finally:
+        stop()
+
+
+def scope(name: str):
+    """Named region inside a trace — the REGISTER_TIMER_INFO analogue;
+    shows as an annotation over the device ops it encloses."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def annotate_fn(name: str):
+    """Decorator form of `scope`."""
+
+    def deco(fn):
+        def wrapped(*a, **kw):
+            with jax.profiler.TraceAnnotation(name):
+                return fn(*a, **kw)
+
+        return wrapped
+
+    return deco

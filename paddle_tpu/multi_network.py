@@ -1,0 +1,79 @@
+"""MultiNetwork: several submodels trained jointly in one program.
+
+Reference: gserver/gradientmachines/MultiNetwork.h — a GradientMachine
+holding N sub-networks, forwarding each with its own in/out args and
+summing their costs into one training signal (used for multi-task
+setups). TPU-first: the submodels are merged into ONE ModelConf (layer
+and parameter names prefixed per submodel, shared-parameter names left
+untouched so submodels can share weights by name) and compiled as a
+single XLA program — the jointly-trained equivalent without a special
+executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+from paddle_tpu.core.config import InputConf, LayerConf, ModelConf
+
+
+def merge_confs(
+    confs: Dict[str, ModelConf],
+    share_params: bool = True,
+) -> ModelConf:
+    """Merge named submodel configs into one ModelConf.
+
+    Layer names become "<sub>/<layer>"; data-layer (feed) names are
+    prefixed too, so each submodel keeps its own inputs. Explicit
+    parameter names (user-set, e.g. shared embeddings) are preserved
+    when `share_params` — identical names across submodels alias one
+    parameter, exactly how MultiNetwork shares via the parameter map.
+    Auto-named parameters (layer-derived "_<layer>.w0") follow their
+    prefixed layer automatically.
+    """
+    merged = ModelConf()
+    for sub, conf in confs.items():
+        names = {lc.name for lc in conf.layers}
+        for lc in conf.layers:
+            nlc = dataclasses.replace(
+                lc,
+                name=f"{sub}/{lc.name}",
+                inputs=[
+                    dataclasses.replace(
+                        ic,
+                        name=(
+                            f"{sub}/{ic.name}"
+                            if ic.name in names
+                            else ic.name
+                        ),
+                    )
+                    for ic in lc.inputs
+                ],
+            )
+            if not share_params:
+                # privatize explicit param names per submodel
+                for ic in nlc.inputs:
+                    if ic.parameter is not None and ic.parameter.name:
+                        ic.parameter = dataclasses.replace(
+                            ic.parameter,
+                            name=f"{sub}/{ic.parameter.name}",
+                        )
+                if nlc.bias_parameter is not None and nlc.bias_parameter.name:
+                    nlc.bias_parameter = dataclasses.replace(
+                        nlc.bias_parameter,
+                        name=f"{sub}/{nlc.bias_parameter.name}",
+                    )
+            merged.layers.append(nlc)
+        merged.input_layer_names.extend(
+            f"{sub}/{n}" for n in conf.input_layer_names
+        )
+        merged.output_layer_names.extend(
+            f"{sub}/{n}" for n in conf.output_layer_names
+        )
+    return merged
+
+
+def prefix_feed(sub: str, feed: dict) -> dict:
+    """Rewrite a submodel's feed dict to merged names."""
+    return {f"{sub}/{k}": v for k, v in feed.items()}

@@ -110,27 +110,6 @@ class Network:
     def _layer_param_view(self, name: str, params: dict) -> dict:
         return {slot: params[g] for slot, g in self.layer_params[name].items()}
 
-    def _cost_only_data(self) -> set:
-        """Data layers whose every direct consumer is a cost layer
-        (labels/targets) — kept float32 under AMP."""
-        cached = getattr(self, "_cost_only_data_cache", None)
-        if cached is not None:
-            return cached
-        consumers: dict[str, list] = {}
-        for lc in self.conf.layers:
-            for n in lc.input_names():
-                consumers.setdefault(n, []).append(lc.name)
-        out = set()
-        for lc in self.conf.layers:
-            if lc.type != "data":
-                continue
-            cons = consumers.get(lc.name, [])
-            if cons and all(
-                getattr(self.layers[c], "is_cost", False) for c in cons
-            ):
-                out.add(lc.name)
-        self._cost_only_data_cache = out
-        return out
 
     # ---- execution ----
     def forward(
@@ -151,30 +130,13 @@ class Network:
         if state is None:
             state = self.init_state()
         # Mixed precision (flags "matmul_precision" = "bfloat16"): master
-        # params stay float32 OUTSIDE this function (the optimizer
-        # updates them in full precision); compute runs in bfloat16 —
-        # halved HBM traffic and single-pass MXU. Cost layers upcast
-        # their inputs back to float32 below so loss/softmax stay exact.
-        # The cast is inside the autodiff region, so grads flow back to
-        # the float32 masters (classic master-weight AMP).
+        # params stay float32; per consuming edge below, compute-layer
+        # operands are cast to bfloat16 (halved HBM traffic, single-pass
+        # MXU) while cost-layer operands stay float32 so targets and
+        # loss math keep full precision. The casts are inside the
+        # autodiff region, so grads flow back to the float32 masters
+        # (classic master-weight AMP).
         amp = _flags.get_flag("matmul_precision") in ("bfloat16", "bf16")
-        if amp:
-            params = {
-                k: (
-                    v.astype(jnp.bfloat16)
-                    if v.dtype == jnp.float32
-                    else v
-                )
-                for k, v in params.items()
-            }
-            # targets consumed only by cost layers keep full precision —
-            # a bf16 round-trip through the feed would corrupt them
-            # before the cost layer's float32 upcast
-            skip = self._cost_only_data()
-            feed = {
-                k: (a if k in skip else _cast_arg(a, jnp.bfloat16))
-                for k, a in feed.items()
-            }
         ctx = Ctx(train=train, rng=rng, state=state)
         outs: dict[str, Arg] = {}
         if outputs is not None:
@@ -211,12 +173,23 @@ class Network:
             inputs = [outs[n] for n in lc.input_names()]
             layer_params = self._layer_param_view(name, params)
             layer = self.layers[name]
-            if amp and getattr(layer, "is_cost", False):
-                inputs = [_cast_arg(a, jnp.float32) for a in inputs]
+            if amp:
+                # per consuming EDGE: cost layers see float32 (targets
+                # straight from the feed keep full precision even if the
+                # same data layer also feeds compute layers), everything
+                # else computes in bfloat16
+                to = (
+                    jnp.float32
+                    if getattr(layer, "is_cost", False)
+                    else jnp.bfloat16
+                )
+                inputs = [_cast_arg(a, to) for a in inputs]
                 layer_params = {
-                    k: v.astype(jnp.float32)
-                    if v.dtype == jnp.bfloat16
-                    else v
+                    k: (
+                        v.astype(to)
+                        if v.dtype in (jnp.float32, jnp.bfloat16)
+                        else v
+                    )
                     for k, v in layer_params.items()
                 }
             outs[name] = layer.forward(layer_params, inputs, ctx)

@@ -58,12 +58,64 @@ def _sched_linear(conf, step):
     return jnp.maximum(lr - conf.learning_rate_decay_a * step, conf.learning_rate_decay_b) / lr
 
 
+def _sched_caffe_poly(conf, step):
+    # lr * (1 - t/a)^b while t <= a, else 0 (CaffePolyLRS). Time axis is
+    # BATCH STEPS like every scheduler here (lr_at docstring); the
+    # reference counts samples — scale decay_a by batch size when
+    # porting configs.
+    t = step
+    a, b = conf.learning_rate_decay_a, conf.learning_rate_decay_b
+    return jnp.where(
+        t <= a, jnp.power(jnp.maximum(1.0 - t / a, 0.0), b), 0.0
+    )
+
+
+def _parse_lr_args(conf):
+    """"seg1:rate1,seg2:rate2,..." (ManualLRS segment table)."""
+    segs, rates = [], []
+    for part in conf.learning_rate_args.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        s, r = part.split(":")
+        segs.append(float(s))
+        rates.append(float(r))
+    assert segs, "manual LR schedule needs learning_rate_args"
+    return segs, rates
+
+
+def _manual_select(segs, rates, t):
+    out = jnp.asarray(rates[-1], jnp.float32)
+    for s, r in reversed(list(zip(segs, rates))):
+        out = jnp.where(t <= s, r, out)
+    return out
+
+
+def _sched_manual(conf, step):
+    # segment table over BATCH STEPS (ManualLRS counts samples — scale
+    # segment boundaries by batch size when porting configs)
+    segs, rates = _parse_lr_args(conf)
+    return _manual_select(segs, rates, step)
+
+
+def _sched_pass_manual(conf, step):
+    # segments over pass number (PassManualLRS); pass index derives
+    # from batches_per_pass when set, else `step` is taken as the pass
+    segs, rates = _parse_lr_args(conf)
+    bpp = getattr(conf, "batches_per_pass", 0)
+    t = jnp.floor(step / bpp) if bpp else step
+    return _manual_select(segs, rates, t)
+
+
 for _n, _f in [
     ("constant", _sched_constant),
     ("poly", _sched_poly),
+    ("caffe_poly", _sched_caffe_poly),
     ("exp", _sched_exp),
     ("discexp", _sched_discexp),
     ("linear", _sched_linear),
+    ("manual", _sched_manual),
+    ("pass_manual", _sched_pass_manual),
 ]:
     LR_SCHEDULERS.register(_n)(type("S_" + _n, (), {"fn": staticmethod(_f)}))
 
@@ -86,6 +138,9 @@ class ParamHyper:
     clip: float = 0.0  # per-parameter clip threshold
     is_static: bool = False
     momentum: Optional[float] = None
+    # static pruning (ParameterUpdaterHook.cpp:39 StaticPruningHook):
+    # fraction of weights masked to zero by initial |value|
+    sparsity_ratio: Optional[float] = None
 
 
 def hyper_from_conf(pc: ParameterConf, opt: OptimizationConf) -> ParamHyper:
@@ -96,7 +151,19 @@ def hyper_from_conf(pc: ParameterConf, opt: OptimizationConf) -> ParamHyper:
         clip=pc.gradient_clipping_threshold or opt.gradient_clipping_threshold,
         is_static=pc.is_static,
         momentum=pc.momentum,
+        sparsity_ratio=getattr(pc, "sparsity_ratio", None),
     )
+
+
+def prune_mask(value: jax.Array, sparsity_ratio: float) -> jax.Array:
+    """0/1 mask keeping EXACTLY the (1 - ratio) largest |value| entries
+    (StaticPruningHook::generateMask). Index-based, so ties (e.g. a
+    constant- or zero-initialized parameter) still honor the ratio."""
+    flat = jnp.abs(value).ravel()
+    keep = max(int(round(flat.size * (1.0 - sparsity_ratio))), 1)
+    order = jnp.argsort(-flat)
+    mask = jnp.zeros_like(flat).at[order[:keep]].set(1.0)
+    return mask.reshape(value.shape).astype(value.dtype)
 
 
 # ---------------- optimizer base ----------------
@@ -111,7 +178,16 @@ class Optimizer:
         self.hypers = hypers  # param name -> ParamHyper
 
     def init_state(self, params: dict) -> dict:
-        return {k: self._init_one(v) for k, v in params.items()}
+        st = {}
+        for k, v in params.items():
+            s = self._init_one(v)
+            h = self.hypers.get(k, ParamHyper())
+            if h.sparsity_ratio:
+                # mask fixed from the INITIAL weights (the reference
+                # generates it once at the first update)
+                s["prune_mask"] = prune_mask(v, h.sparsity_ratio)
+            st[k] = s
+        return st
 
     def update(self, grads: dict, params: dict, state: dict, step) -> tuple:
         """Returns (new_params, new_state). `step` is the global batch
@@ -124,6 +200,11 @@ class Optimizer:
             if g is None or h.is_static:
                 new_p[k], new_s[k] = p, state[k]
                 continue
+            mask = state[k].get("prune_mask") if isinstance(
+                state[k], dict
+            ) else None
+            if mask is not None:  # StaticPruningHook::update grad mask
+                g = g * mask
             if h.clip > 0.0:
                 g = jnp.clip(g, -h.clip, h.clip)
             # L2 decay folded into gradient (reference applies decay in the
@@ -136,6 +217,11 @@ class Optimizer:
             if h.l1 > 0.0:
                 shrink = lr * h.lr_mult * h.l1
                 np_ = jnp.sign(np_) * jnp.maximum(jnp.abs(np_) - shrink, 0.0)
+            if mask is not None:
+                # keep pruned weights exactly zero (decay/momentum must
+                # not revive them) and carry the mask in the new state
+                np_ = np_ * mask
+                ns_["prune_mask"] = mask
             new_p[k], new_s[k] = np_, ns_
         return new_p, new_s
 

@@ -55,19 +55,31 @@ class Ploter:
             if path:
                 matplotlib.use("Agg")
             import matplotlib.pyplot as plt
+
+            # backend resolution is deferred to first figure creation —
+            # a broken GUI backend on a headless box fails HERE, which
+            # still means "matplotlib unavailable": fall back to text
+            fig, ax = plt.subplots()
         except Exception:
             self._print_latest()
             return
-        fig, ax = plt.subplots()
         try:
             for title in self.__args__:
                 d = self.__plot_data__[title]
                 ax.plot(d.step, d.value, label=title)
             ax.legend()
+            backend = matplotlib.get_backend().lower()
+            headless = any(
+                b in backend
+                for b in ("agg", "pdf", "svg", "ps", "template", "cairo",
+                          "pgf")
+            )
             if path:
-                fig.savefig(path)
-            elif matplotlib.get_backend().lower() == "agg":
-                self._print_latest()  # headless: nothing to show
+                fig.savefig(path)  # save errors propagate
+            elif headless and not matplotlib.is_interactive():
+                # nothing would be displayed — print instead of a
+                # silent plt.show() no-op
+                self._print_latest()
             else:
                 plt.show()
         finally:

@@ -57,6 +57,13 @@ class SGD:
         self.opt = create_optimizer(opt_conf, self.net.param_confs)
         self.mesh = mesh
         self.evaluator_confs = evaluators or []
+        # FP-exception trap (TrainerMain.cpp:49 feenableexcept): jax
+        # re-runs NaN-producing ops un-jitted and raises. Set from the
+        # flag unconditionally so a previous trainer's setting does not
+        # leak into this one.
+        jax.config.update(
+            "jax_debug_nans", bool(_flags.get_flag("trap_fp"))
+        )
         key = _rng.root_key(seed or _flags.get_flag("seed"))
         init_key, self.step_key = jax.random.split(key)
         self.params = params if params is not None else self.net.init_params(init_key)

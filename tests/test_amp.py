@@ -100,6 +100,35 @@ def test_amp_keeps_regression_targets_fp32(amp_flag):
     assert abs(got - want) / want < 1e-3, (got, want)
 
 
+def test_amp_target_with_extra_noncost_consumer_stays_fp32(amp_flag):
+    # the target feeds BOTH the cost layer and a compute layer; the cost
+    # edge must still see the full-precision value (per-edge casting)
+    with dsl.model() as g:
+        x = dsl.data("x", 4)
+        t = dsl.data("t", 1)
+        out = dsl.fc(x, size=1, name="pred")
+        side = dsl.scaling(t, out, name="side")  # non-cost consumer
+        dsl.square_error(out, t, name="cost")
+        g.conf.output_layer_names.extend(["pred", "side"])
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    feed = {
+        "x": non_seq(jnp.ones((2, 4))),
+        "t": non_seq(jnp.full((2, 1), 1000.3, jnp.float32)),
+    }
+    loss, (outs, _) = net.loss_fn(params, feed)
+    pred = jnp.asarray(outs["pred"].value, jnp.float32)
+    want = float(jnp.mean(0.5 * (pred[:, 0] - 1000.3) ** 2))
+    assert abs(float(loss) - want) / want < 1e-3, (float(loss), want)
+
+
+def test_prune_mask_handles_ties():
+    from paddle_tpu.optimizers import prune_mask
+
+    m = prune_mask(jnp.zeros((10, 10)), 0.9)
+    assert float(m.sum()) == 10  # exactly (1-ratio) kept despite ties
+
+
 def test_amp_matches_fp32_closely():
     conf = _conv_net()
     net = Network(conf)

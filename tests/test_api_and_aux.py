@@ -1,0 +1,229 @@
+"""Tests for the SWIG-analogue api module, MultiNetwork merging, the
+new LR schedulers, the static pruning hook, profiler scopes, and the
+FP-trap flag (reference: paddle/api/, MultiNetwork.h,
+LearningRateScheduler.cpp, ParameterUpdaterHook.cpp:39, Stat.h,
+TrainerMain.cpp:49)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import api, dsl
+from paddle_tpu.core import profiler
+from paddle_tpu.core.arg import id_arg, non_seq
+from paddle_tpu.core.config import (
+    OptimizationConf,
+    ParameterConf,
+)
+from paddle_tpu.multi_network import merge_confs, prefix_feed
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer, lr_at, prune_mask
+
+
+def _clf_conf(in_dim=6, classes=3, pname=None):
+    with dsl.model() as g:
+        x = dsl.data("x", in_dim)
+        y = dsl.data("y", 1, is_ids=True)
+        h = dsl.fc(x, size=8, act="tanh", name="h",
+                   param=ParameterConf(name=pname) if pname else None)
+        out = dsl.fc(h, size=classes, name="out")
+        dsl.classification_cost(out, y, name="cost")
+        g.conf.output_layer_names.append("out")
+    return g.conf
+
+
+class TestLRSchedulers:
+    def _conf(self, **kw):
+        return OptimizationConf(learning_rate=0.1, **kw)
+
+    def test_caffe_poly(self):
+        c = self._conf(learning_rate_schedule="caffe_poly",
+                       learning_rate_decay_a=100.0,
+                       learning_rate_decay_b=2.0, batch_size=1)
+        assert float(lr_at(c, 0)) == pytest.approx(0.1)
+        assert float(lr_at(c, 50)) == pytest.approx(0.1 * 0.25)
+        assert float(lr_at(c, 200)) == 0.0
+
+    def test_manual(self):
+        c = self._conf(learning_rate_schedule="manual",
+                       learning_rate_args="10:1.0,20:0.5,30:0.1",
+                       batch_size=1)
+        assert float(lr_at(c, 5)) == pytest.approx(0.1)
+        assert float(lr_at(c, 15)) == pytest.approx(0.05)
+        assert float(lr_at(c, 99)) == pytest.approx(0.01)
+
+    def test_pass_manual(self):
+        c = self._conf(learning_rate_schedule="pass_manual",
+                       learning_rate_args="0:1.0,1:0.5",
+                       batches_per_pass=10)
+        assert float(lr_at(c, 5)) == pytest.approx(0.1)  # pass 0
+        assert float(lr_at(c, 15)) == pytest.approx(0.05)  # pass 1
+        assert float(lr_at(c, 35)) == pytest.approx(0.05)  # beyond: last
+
+
+class TestPruningHook:
+    def test_mask_shape_and_ratio(self):
+        v = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)))
+        m = prune_mask(v, 0.75)
+        assert float(m.sum()) == pytest.approx(16)  # 25% kept
+        # kept entries are the largest-|v| ones
+        kept = np.abs(np.asarray(v))[np.asarray(m) > 0]
+        dropped = np.abs(np.asarray(v))[np.asarray(m) == 0]
+        assert kept.min() >= dropped.max()
+
+    def test_training_respects_mask(self):
+        conf = _clf_conf()
+        conf.layer("h").inputs[0].parameter = ParameterConf(
+            sparsity_ratio=0.5
+        )
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="momentum",
+                             learning_rate=0.1, momentum=0.9,
+                             l2_rate=1e-3),
+            net.param_confs,
+        )
+        st = opt.init_state(params)
+        wname = [n for n in params if n.endswith("h.w0")][0]
+        mask = np.asarray(st[wname]["prune_mask"])
+        assert mask.sum() == pytest.approx(mask.size * 0.5)
+        rng = np.random.default_rng(1)
+        feed = {
+            "x": non_seq(jnp.asarray(
+                rng.standard_normal((16, 6)), jnp.float32)),
+            "y": id_arg(jnp.asarray(rng.integers(0, 3, 16), jnp.int32)),
+        }
+
+        @jax.jit
+        def step(params, st, i):
+            (l, _), g = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed)
+            return *opt.update(g, params, st, i), l
+
+        for i in range(10):
+            params, st, loss = step(params, st, i)
+        w = np.asarray(params[wname])
+        assert (w[mask == 0] == 0).all()  # pruned stay exactly zero
+        assert (w[mask == 1] != 0).any()
+
+
+class TestMultiNetwork:
+    def test_merge_and_joint_training(self):
+        merged = merge_confs(
+            {"a": _clf_conf(pname="shared_w"),
+             "b": _clf_conf(pname="shared_w")}
+        )
+        net = Network(merged)
+        # one shared parameter + private ones
+        assert "shared_w" in net.param_confs
+        assert len(net.cost_names) == 2
+        params = net.init_params(jax.random.key(0))
+        rng = np.random.default_rng(2)
+        feed = {}
+        for sub in ("a", "b"):
+            feed.update(prefix_feed(sub, {
+                "x": non_seq(jnp.asarray(
+                    rng.standard_normal((8, 6)), jnp.float32)),
+                "y": id_arg(jnp.asarray(
+                    rng.integers(0, 3, 8), jnp.int32)),
+            }))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="adam", learning_rate=0.02),
+            net.param_confs,
+        )
+        st = opt.init_state(params)
+
+        @jax.jit
+        def step(params, st, i):
+            (l, _), g = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed)
+            return *opt.update(g, params, st, i), l
+
+        first = None
+        for i in range(30):
+            params, st, loss = step(params, st, i)
+            if i == 0:
+                first = float(loss)
+        assert float(loss) < first * 0.8
+
+    def test_private_params(self):
+        merged = merge_confs(
+            {"a": _clf_conf(pname="w"), "b": _clf_conf(pname="w")},
+            share_params=False,
+        )
+        net = Network(merged)
+        assert "a/w" in net.param_confs and "b/w" in net.param_confs
+
+
+class TestApiModule:
+    def test_gradient_machine_roundtrip(self):
+        gm = api.GradientMachine.createFromConfigProto(_clf_conf())
+        names = gm.getParameterNames()
+        assert any(n.endswith("out.w0") for n in names)
+        rng = np.random.default_rng(3)
+        args = api.Arguments.createArguments(2)
+        args.setSlotValue(
+            0, api.Matrix.createDenseFromNumpy(
+                rng.standard_normal((4, 6)).astype(np.float32))
+        )
+        args.setSlotIds(
+            1, api.IVector.createVectorFromNumpy(
+                rng.integers(0, 3, 4).astype(np.int32))
+        )
+        feed = {"x": args.slots()[0], "y": args.slots()[1]}
+        outs = gm.forward(feed, outputs=["out"])
+        assert outs["out"].value.shape == (4, 3)
+        cost, _ = gm.forwardBackward(feed)
+        assert np.isfinite(cost)
+        g = gm.getGradient(names[0])
+        assert g.shape == gm.getParameter(names[0]).shape
+
+        upd = api.ParameterUpdater.createLocalUpdater(
+            OptimizationConf(learning_method="sgd", learning_rate=0.1),
+            gm,
+        )
+        before = gm.getParameter(names[0]).copy()
+        upd.update()
+        assert not np.allclose(before, gm.getParameter(names[0]))
+
+    def test_matrix_ivector(self):
+        m = api.Matrix.createDenseFromNumpy(np.eye(3, dtype=np.float32))
+        assert m.getHeight() == m.getWidth() == 3
+        v = api.IVector.createVectorFromNumpy(np.asarray([1, 2]))
+        assert v.toNumpyArray().tolist() == [1, 2]
+
+
+class TestProfiler:
+    def test_trace_and_scope(self, tmp_path):
+        d = str(tmp_path / "trace")
+        with profiler.trace(d):
+            with profiler.scope("matmul_region"):
+                x = jnp.ones((64, 64))
+                (x @ x).block_until_ready()
+        import os
+
+        assert any(os.scandir(d))  # xplane artifacts written
+
+        @profiler.annotate_fn("fn_region")
+        def f(a):
+            return a * 2
+
+        assert float(f(jnp.asarray(3.0))) == 6.0
+
+
+class TestTrapFP:
+    def test_trap_fp_flag(self):
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.trainer import SGD
+
+        F.set_flag("trap_fp", True)
+        try:
+            SGD(_clf_conf(), OptimizationConf(learning_method="sgd"))
+            assert jax.config.jax_debug_nans
+        finally:
+            F.set_flag("trap_fp", False)
+            jax.config.update("jax_debug_nans", False)
