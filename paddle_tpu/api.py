@@ -11,7 +11,8 @@ new code should use those modules directly.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import dataclasses
+from typing import Sequence
 
 import jax
 import numpy as np
@@ -82,15 +83,11 @@ class Arguments:
         return len(self._args)
 
     def setSlotValue(self, i: int, m: Matrix):
-        import dataclasses
-
         self._args[i] = dataclasses.replace(
             self._args[i], value=jax.numpy.asarray(m.toNumpyMat())
         )
 
     def setSlotIds(self, i: int, v: IVector):
-        import dataclasses
-
         self._args[i] = dataclasses.replace(
             self._args[i], ids=jax.numpy.asarray(v.toNumpyArray())
         )

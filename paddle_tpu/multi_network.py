@@ -51,6 +51,15 @@ def merge_confs(
                     for ic in lc.inputs
                 ],
             )
+            if "step_conf" in nlc.attrs:
+                # recurrent groups carry layer-name references in attrs:
+                # memories[].boot_layer names a PARENT layer; the step
+                # net's own layer names must be prefixed too so two
+                # merged submodels' auto-named step params ("_s.w0")
+                # never alias
+                nlc.attrs = _prefix_group_attrs(
+                    sub, nlc.attrs, share_params
+                )
             if not share_params:
                 # privatize explicit param names per submodel
                 for ic in nlc.inputs:
@@ -72,6 +81,52 @@ def merge_confs(
             f"{sub}/{n}" for n in conf.output_layer_names
         )
     return merged
+
+
+def _prefix_group_attrs(sub: str, attrs: dict, share_params: bool) -> dict:
+    """Prefix the layer-name references inside a recurrent_group's attrs
+    (layers/recurrent_group.py:19-27): step_conf layer names +
+    in/static/out_links + memories' step-side "layer"/"link" get the
+    submodel prefix; memories' parent-side "boot_layer" gets it too
+    (the parent layer itself was just renamed)."""
+    a = dict(attrs)
+    p = lambda n: f"{sub}/{n}" if n else n
+    step: ModelConf = a["step_conf"]
+    new_step = ModelConf()
+    for lc in step.layers:
+        nlc = dataclasses.replace(
+            lc,
+            name=p(lc.name),
+            inputs=[
+                dataclasses.replace(ic, name=p(ic.name))
+                for ic in lc.inputs
+            ],
+        )
+        if not share_params:
+            for ic in nlc.inputs:
+                if ic.parameter is not None and ic.parameter.name:
+                    ic.parameter = dataclasses.replace(
+                        ic.parameter, name=p(ic.parameter.name)
+                    )
+            if nlc.bias_parameter is not None and nlc.bias_parameter.name:
+                nlc.bias_parameter = dataclasses.replace(
+                    nlc.bias_parameter, name=p(nlc.bias_parameter.name)
+                )
+        new_step.layers.append(nlc)
+    a["step_conf"] = new_step
+    a["in_links"] = [p(n) for n in a.get("in_links", [])]
+    a["static_links"] = [p(n) for n in a.get("static_links", [])]
+    a["out_links"] = [p(n) for n in a.get("out_links", [])]
+    a["memories"] = [
+        {
+            **m,
+            "layer": p(m.get("layer")),
+            "link": p(m.get("link")),
+            "boot_layer": p(m.get("boot_layer")),
+        }
+        for m in a.get("memories", [])
+    ]
+    return a
 
 
 def prefix_feed(sub: str, feed: dict) -> dict:

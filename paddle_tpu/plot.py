@@ -68,11 +68,12 @@ class Ploter:
                 d = self.__plot_data__[title]
                 ax.plot(d.step, d.value, label=title)
             ax.legend()
+            # exact names only: GUI backends like GTK3Cairo/TkCairo must
+            # NOT match; "agg" as a suffix covers only the pure
+            # rasterizer ("agg"/"macosx" etc. are distinct names)
             backend = matplotlib.get_backend().lower()
-            headless = any(
-                b in backend
-                for b in ("agg", "pdf", "svg", "ps", "template", "cairo",
-                          "pgf")
+            headless = backend in (
+                "agg", "pdf", "svg", "ps", "template", "cairo", "pgf",
             )
             if path:
                 fig.savefig(path)  # save errors propagate

@@ -119,3 +119,67 @@ def load_merged(path: str):
         conf = ModelConf.from_json(bytes(z["__config__"]).decode())
         tree = _unflatten({k: z[k] for k in z.files if k != "__config__"})
     return conf, tree.get("params", {}), tree.get("state", {})
+
+
+def to_tar(f, params: dict, param_confs: dict = None):
+    """Write parameters as a tar archive — the v2 checkpoint format
+    (python/paddle/v2/parameters.py:304 to_tar): one member per
+    parameter holding raw little-endian float32 bytes, plus a
+    `<name>.conf` JSON sidecar with its config (the reference stores
+    the ParameterConfig proto the same way). `f` is a writable binary
+    file object or a path."""
+    import io
+    import tarfile
+
+    own = isinstance(f, (str, os.PathLike))
+    fh = open(f, "wb") if own else f
+    try:
+        with tarfile.open(fileobj=fh, mode="w") as tar:
+
+            def add(name, data: bytes):
+                info = tarfile.TarInfo(name=name)
+                info.size = len(data)
+                tar.addfile(info, io.BytesIO(data))
+
+            for name in sorted(params):
+                arr = np.ascontiguousarray(
+                    np.asarray(params[name]), np.float32
+                )
+                add(name, arr.tobytes())
+                conf = {"shape": list(arr.shape)}
+                if param_confs and name in param_confs:
+                    pc = param_confs[name]
+                    conf["config"] = (
+                        pc.to_dict() if hasattr(pc, "to_dict") else {}
+                    )
+                add(name + ".conf", json.dumps(conf).encode())
+    finally:
+        if own:
+            fh.close()
+
+
+def from_tar(f) -> dict:
+    """Read a to_tar archive back into {name: np.ndarray}
+    (parameters.py:323 from_tar)."""
+    import tarfile
+
+    own = isinstance(f, (str, os.PathLike))
+    params: dict = {}
+    shapes: dict = {}
+    tar = tarfile.open(f) if own else tarfile.open(fileobj=f)
+    with tar:
+        for member in tar.getmembers():
+            data = tar.extractfile(member).read()
+            if member.name.endswith(".conf"):
+                shapes[member.name[: -len(".conf")]] = json.loads(
+                    data.decode()
+                )["shape"]
+            else:
+                # copy: frombuffer over tar bytes is read-only
+                params[member.name] = np.frombuffer(
+                    data, np.float32
+                ).copy()
+    return {
+        k: v.reshape(shapes[k]) if k in shapes else v
+        for k, v in params.items()
+    }

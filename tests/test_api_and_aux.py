@@ -227,3 +227,51 @@ class TestTrapFP:
         finally:
             F.set_flag("trap_fp", False)
             jax.config.update("jax_debug_nans", False)
+
+
+class TestMultiNetworkRecurrentGroup:
+    def _rnn_conf(self):
+        with dsl.model() as g:
+            x = dsl.data("x", 4, is_seq=True)
+            y = dsl.data("y", 1, is_ids=True)
+            boot = dsl.fc(dsl.data("b0", 4), size=8, name="enc")
+
+            def step(xt):
+                prev = dsl.memory("s", size=8, boot_layer=boot)
+                s = dsl.fc(xt, prev, size=8, act="tanh", name="s")
+                return s
+
+            h = dsl.recurrent_group(step, [x], name="rg")
+            p = dsl.last_seq(h)
+            out = dsl.fc(p, size=3, name="out")
+            dsl.classification_cost(out, y, name="cost")
+        return g.conf
+
+    def test_merged_groups_run_and_do_not_alias(self):
+        merged = merge_confs(
+            {"a": self._rnn_conf(), "b": self._rnn_conf()},
+            share_params=False,
+        )
+        net = Network(merged)
+        # step-net auto params are per-submodel (no aliasing)
+        step_params = [n for n in net.param_confs if "s.w" in n]
+        assert any("a/" in n for n in step_params)
+        assert any("b/" in n for n in step_params)
+        # distinct objects per submodel — no aliasing
+        assert len(step_params) == 6
+        params = net.init_params(jax.random.key(0))
+        rng = np.random.default_rng(0)
+        feed = {}
+        from paddle_tpu.core.arg import seq
+
+        for sub in ("a", "b"):
+            feed.update(prefix_feed(sub, {
+                "x": seq(jnp.asarray(
+                    rng.standard_normal((2, 5, 4)), jnp.float32),
+                    jnp.asarray([5, 3], jnp.int32)),
+                "b0": non_seq(jnp.asarray(
+                    rng.standard_normal((2, 4)), jnp.float32)),
+                "y": id_arg(jnp.asarray([0, 1], jnp.int32)),
+            }))
+        loss, _ = net.loss_fn(params, feed)
+        assert np.isfinite(float(loss))

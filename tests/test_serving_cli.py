@@ -253,3 +253,26 @@ class TestCAPI:
         inf = Inferencer(net, params, outputs=["output"])
         want = inf.infer({"x": non_seq(jnp.asarray(x))})["output"]
         np.testing.assert_allclose(got, np.asarray(want), atol=1e-5)
+
+
+class TestTarFormat:
+    def test_to_from_tar_roundtrip(self, tmp_path):
+        merged, net, params = _merged_model(tmp_path)
+        p = str(tmp_path / "params.tar")
+        ckpt.to_tar(p, params, net.param_confs)
+        back = ckpt.from_tar(p)
+        assert sorted(back) == sorted(params)
+        for k in params:
+            np.testing.assert_allclose(
+                back[k], np.asarray(params[k]), rtol=1e-6
+            )
+
+    def test_to_tar_fileobj(self, tmp_path):
+        import io
+
+        merged, net, params = _merged_model(tmp_path)
+        buf = io.BytesIO()
+        ckpt.to_tar(buf, params)
+        buf.seek(0)
+        back = ckpt.from_tar(buf)
+        assert sorted(back) == sorted(params)
