@@ -39,6 +39,9 @@ class ParameterConf:
     # static pruning hook (ParameterUpdaterHook.cpp:39): fraction of
     # weights zero-masked by initial magnitude; None = no pruning
     sparsity_ratio: Optional[float] = None
+    # MoE expert weight [E, ...]: shard the leading expert dim over the
+    # mesh model axis (expert parallelism)
+    expert_sharded: bool = False
 
     def to_dict(self):
         d = dataclasses.asdict(self)

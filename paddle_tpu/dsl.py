@@ -417,6 +417,20 @@ def multibox_loss(priorbox_ref, gt_box, gt_label, loc_pred, conf_pred,
                 background_id=background_id)
 
 
+def moe(x, num_experts, hidden=None, name=None, capacity_factor=1.25,
+        expert_act="relu", aux_loss_coeff=0.01):
+    """Sparsely-activated mixture-of-experts FFN (layers/moe.py). Wires
+    the layer's load-balancing aux output into a sum_cost so the
+    trainer applies it alongside the task loss."""
+    ref = _add("moe", [x], name=name, bias=False, num_experts=num_experts,
+               hidden=hidden or 0, capacity_factor=capacity_factor,
+               expert_act=expert_act)
+    if aux_loss_coeff:
+        sum_cost(LayerRef(f"{ref.name}@aux", current()),
+                 name=f"{ref.name}@aux_cost", coeff=aux_loss_coeff)
+    return ref
+
+
 def dot_mul(a, b, name=None, act=""):
     """Elementwise product of two same-size layers (DotMulOperator)."""
     return _add("dot_mul", [a, b], name=name, bias=False, act=act)

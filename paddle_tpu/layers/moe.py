@@ -1,0 +1,82 @@
+"""MoE layer: sparsely-activated expert FFN with load-balancing loss.
+
+Beyond-reference capability (expert parallelism). The layer emits its
+aux load-balancing loss as an extra output `<name>@aux` that the DSL
+wires into a sum_cost, so the trainer's multi-cost reduction (the same
+mechanism the VAE demo uses) applies it; expert weights carry an
+"expert" leading dim that parallel/sharding can place on the mesh model
+axis for EP.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.ops import moe as moe_ops
+from paddle_tpu.ops import activations
+
+
+@LAYERS.register("moe")
+class MoELayer(Layer):
+    """attrs: num_experts, hidden (expert FFN width), capacity_factor,
+    expert_act. size = output dim (== input dim). Params: router w0
+    [D, E]; experts w_in [E, D, H], w_out [E, H, D]."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        d = s.size
+        a = self.conf.attrs
+        E = a["num_experts"]
+        H = a.get("hidden") or 4 * d
+        pcs = {
+            "w0": self.weight_conf(0, (d, E)),
+            "w_in": self.weight_conf(0, (E, d, H)),
+            "w_out": self.weight_conf(0, (E, H, d)),
+        }
+        # distinct auto-names for the three slots
+        pcs["w_in"].name = pcs["w0"].name + "_in"
+        pcs["w_out"].name = pcs["w0"].name + "_out"
+        pcs["w_in"].expert_sharded = True
+        pcs["w_out"].expert_sharded = True
+        # per-expert fan-in: each token multiplies ONE [D,H] slice, so
+        # std is 1/sqrt(D) (init_parameter's prod(dims[:-1]) would give
+        # 1/sqrt(E*D) — E-times too small). User-set std wins.
+        if pcs["w_in"].initial_std is None:
+            pcs["w_in"].initial_std = 1.0 / (d ** 0.5)
+        if pcs["w_out"].initial_std is None:
+            pcs["w_out"].initial_std = 1.0 / (H ** 0.5)
+        self._spec = s
+        return s, pcs
+
+    def extra_output_specs(self):
+        return {f"{self.name}@aux": Spec(dim=(1,))}
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        a = self.conf.attrs
+        act = activations.get(a.get("expert_act", "relu"))
+        v = x.value
+        lead = v.shape[:-1]
+        flat = v.reshape(-1, v.shape[-1])
+        # padded tokens are excluded from routing itself (capacity and
+        # balance statistics), not just output-masked
+        token_mask = (
+            x.mask(v.dtype).reshape(-1) if x.is_seq else None
+        )
+        y, aux = moe_ops.moe_ffn(
+            flat,
+            params["w0"],
+            params["w_in"],
+            params["w_out"],
+            capacity_factor=a.get("capacity_factor", 1.25),
+            activation=act,
+            token_mask=token_mask,
+        )
+        y = y.reshape(lead + (-1,))
+        self._extra_outs = {
+            f"{self.name}@aux": Arg(value=jnp.broadcast_to(aux, (1, 1)))
+        }
+        return Arg(value=y, seq_lens=x.seq_lens)

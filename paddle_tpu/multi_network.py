@@ -34,7 +34,27 @@ def merge_confs(
     """
     merged = ModelConf()
     for sub, conf in confs.items():
+        # referenceable names: the submodel's layers, plus every step-net
+        # layer (recurrent-group secondary out_links surface under step
+        # names), recursively for nested groups
         names = {lc.name for lc in conf.layers}
+        stack = [
+            lc.attrs["step_conf"]
+            for lc in conf.layers
+            if "step_conf" in lc.attrs
+        ]
+        while stack:
+            sc = stack.pop()
+            for slc in sc.layers:
+                names.add(slc.name)
+                if "step_conf" in slc.attrs:
+                    stack.append(slc.attrs["step_conf"])
+
+        def _ref(n):
+            # extra outputs ("moe@aux") reference their producer layer
+            # before the '@'; prefix whenever the base is local
+            return n in names or n.split("@")[0] in names
+
         for lc in conf.layers:
             nlc = dataclasses.replace(
                 lc,
@@ -43,9 +63,7 @@ def merge_confs(
                     dataclasses.replace(
                         ic,
                         name=(
-                            f"{sub}/{ic.name}"
-                            if ic.name in names
-                            else ic.name
+                            f"{sub}/{ic.name}" if _ref(ic.name) else ic.name
                         ),
                     )
                     for ic in lc.inputs
@@ -102,6 +120,8 @@ def _prefix_group_attrs(sub: str, attrs: dict, share_params: bool) -> dict:
                 for ic in lc.inputs
             ],
         )
+        if "step_conf" in nlc.attrs:  # nested recurrent group
+            nlc.attrs = _prefix_group_attrs(sub, nlc.attrs, share_params)
         if not share_params:
             for ic in nlc.inputs:
                 if ic.parameter is not None and ic.parameter.name:

@@ -1,0 +1,98 @@
+"""Mixture-of-Experts routing and expert-parallel FFN.
+
+Beyond-reference capability (expert parallelism in the SURVEY §2
+parallelism table). GShard-style fixed-capacity top-1/top-2 routing:
+token->expert assignment becomes dense dispatch/combine einsum tensors
+(static shapes, MXU-friendly), so XLA's GSPMD inserts the all-to-all
+when the expert axis of the expert weights is sharded over the mesh.
+Aux load-balancing loss per GShard/Switch.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def top1_routing(
+    gate_logits: jax.Array,
+    capacity: int,
+    token_mask: jax.Array = None,
+):
+    """gate_logits [N, E] -> (dispatch [N, E, C] one-hot, combine
+    [N, E, C] prob-weighted, aux_loss scalar).
+
+    Tokens beyond an expert's capacity C are dropped (standard Switch
+    behavior); position within the expert buffer is the token's rank
+    among tokens routed to that expert. `token_mask` [N] (1 = real)
+    excludes padded tokens BEFORE the rank cumsum so padding never
+    consumes expert capacity or skews the balance statistics.
+    """
+    N, E = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.max(probs, axis=-1)  # [N]
+
+    onehot = jax.nn.one_hot(expert, E, dtype=probs.dtype)  # [N, E]
+    if token_mask is not None:
+        onehot = onehot * token_mask[:, None]
+    # rank of each token within its expert (0-based arrival order)
+    pos = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
+    pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
+    keep = pos_in_expert < capacity
+    if token_mask is not None:
+        keep = keep & (token_mask > 0)
+    gate = gate * keep
+
+    dispatch = (
+        onehot[:, :, None]
+        * jax.nn.one_hot(pos_in_expert, capacity, dtype=probs.dtype)[
+            :, None, :
+        ]
+        * keep[:, None, None]
+    )  # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+
+    # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e,
+    # statistics over REAL tokens only
+    if token_mask is None:
+        denom = float(N)
+        probs_sum = jnp.sum(probs, axis=0)
+    else:
+        denom = jnp.maximum(jnp.sum(token_mask), 1.0)
+        probs_sum = jnp.sum(probs * token_mask[:, None], axis=0)
+    frac_tokens = jnp.sum(onehot, axis=0) / denom  # f_e
+    frac_probs = probs_sum / denom  # p_e
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def moe_ffn(
+    x: jax.Array,
+    router_w: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    capacity_factor: float = 1.25,
+    activation=jax.nn.relu,
+    token_mask: jax.Array = None,
+):
+    """x [N, D]; router_w [D, E]; w_in [E, D, H]; w_out [E, H, D].
+    Returns (y [N, D], aux_loss). token_mask [N] excludes padding from
+    routing entirely.
+
+    Shard w_in/w_out on the expert axis (PartitionSpec("model" | "expert"
+    , ...)) for expert parallelism — the dispatch einsum then lowers to
+    an all-to-all over ICI.
+    """
+    N = x.shape[0]
+    E = router_w.shape[1]
+    capacity = max(int(capacity_factor * N / E), 1)
+    dispatch, combine, aux = top1_routing(
+        x @ router_w, capacity, token_mask=token_mask
+    )
+    # [E, C, D]: expert input buffers
+    xin = jnp.einsum("nd,nec->ecd", x, dispatch)
+    h = activation(jnp.einsum("ecd,edh->ech", xin, w_in))
+    yout = jnp.einsum("ech,ehd->ecd", h, w_out)
+    y = jnp.einsum("ecd,nec->nd", yout, combine)
+    return y, aux

@@ -50,6 +50,10 @@ def auto_param_spec(pc, mesh: Mesh) -> P:
         return P()
     if m <= 1:
         return P()
+    if getattr(pc, "expert_sharded", False) and dims and dims[0] % m == 0:
+        # MoE expert weights [E, ...]: experts over the model axis (EP);
+        # GSPMD turns the dispatch einsum into an all-to-all
+        return P(*([MODEL_AXIS] + [None] * (len(dims) - 1)))
     if len(dims) == 2 and dims[1] % m == 0 and dims[1] >= m:
         return P(None, MODEL_AXIS)
     if len(dims) == 4 and dims[-1] % m == 0:  # conv kernels HWIO
