@@ -1,11 +1,13 @@
 """Mixture-of-Experts routing and expert-parallel FFN.
 
 Beyond-reference capability (expert parallelism in the SURVEY §2
-parallelism table). GShard-style fixed-capacity top-1/top-2 routing:
+parallelism table). Switch-style fixed-capacity TOP-1 routing:
 token->expert assignment becomes dense dispatch/combine einsum tensors
 (static shapes, MXU-friendly), so XLA's GSPMD inserts the all-to-all
 when the expert axis of the expert weights is sharded over the mesh.
-Aux load-balancing loss per GShard/Switch.
+Tokens route within fixed-size GROUPS (GShard's [G, S, ...] layout) so
+dispatch tensors stay O(N * group_size) instead of O(N^2). Aux
+load-balancing loss per GShard/Switch eq. 4.
 """
 
 from __future__ import annotations
@@ -33,9 +35,12 @@ def top1_routing(
     expert = jnp.argmax(probs, axis=-1)  # [N]
     gate = jnp.max(probs, axis=-1)  # [N]
 
-    onehot = jax.nn.one_hot(expert, E, dtype=probs.dtype)  # [N, E]
+    # rank accounting runs in float32 REGARDLESS of the activation
+    # dtype: a bfloat16 cumsum loses integer exactness past 256 and
+    # silently collides capacity slots under AMP
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)  # [N, E]
     if token_mask is not None:
-        onehot = onehot * token_mask[:, None]
+        onehot = onehot * token_mask.astype(jnp.float32)[:, None]
     # rank of each token within its expert (0-based arrival order)
     pos = jnp.cumsum(onehot, axis=0) - onehot  # [N, E]
     pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [N]
@@ -46,11 +51,11 @@ def top1_routing(
 
     dispatch = (
         onehot[:, :, None]
-        * jax.nn.one_hot(pos_in_expert, capacity, dtype=probs.dtype)[
+        * jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)[
             :, None, :
         ]
         * keep[:, None, None]
-    )  # [N, E, C]
+    ).astype(probs.dtype)  # [N, E, C]
     combine = dispatch * gate[:, None, None]
 
     # load-balancing aux loss (Switch eq. 4): E * sum_e f_e * p_e,
@@ -67,6 +72,13 @@ def top1_routing(
     return dispatch, combine, aux
 
 
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    for g in range(min(cap, n), 0, -1):
+        if n % g == 0:
+            return g
+    return n
+
+
 def moe_ffn(
     x: jax.Array,
     router_w: jax.Array,
@@ -75,10 +87,16 @@ def moe_ffn(
     capacity_factor: float = 1.25,
     activation=jax.nn.relu,
     token_mask: jax.Array = None,
+    group_size: int = 1024,
 ):
     """x [N, D]; router_w [D, E]; w_in [E, D, H]; w_out [E, H, D].
     Returns (y [N, D], aux_loss). token_mask [N] excludes padding from
     routing entirely.
+
+    Tokens route within groups of (at most) `group_size` — the largest
+    divisor of N is used — so dispatch/combine are [G, S, E, C] with
+    C = cf*S/E: memory and FLOPs stay O(N * group_size), GShard's
+    grouped layout, instead of O(N^2) for one global group.
 
     Shard w_in/w_out on the expert axis (PartitionSpec("model" | "expert"
     , ...)) for expert parallelism — the dispatch einsum then lowers to
@@ -86,13 +104,24 @@ def moe_ffn(
     """
     N = x.shape[0]
     E = router_w.shape[1]
-    capacity = max(int(capacity_factor * N / E), 1)
-    dispatch, combine, aux = top1_routing(
-        x @ router_w, capacity, token_mask=token_mask
+    S = _largest_divisor_leq(N, group_size)
+    G = N // S
+    capacity = max(int(capacity_factor * S / E), 1)
+    logits = (x @ router_w).reshape(G, S, E)
+    xg = x.reshape(G, S, -1)
+    mg = token_mask.reshape(G, S) if token_mask is not None else None
+    route = jax.vmap(
+        lambda l, m: top1_routing(l, capacity, token_mask=m)
     )
-    # [E, C, D]: expert input buffers
-    xin = jnp.einsum("nd,nec->ecd", x, dispatch)
-    h = activation(jnp.einsum("ecd,edh->ech", xin, w_in))
-    yout = jnp.einsum("ech,ehd->ecd", h, w_out)
-    y = jnp.einsum("ecd,nec->nd", yout, combine)
-    return y, aux
+    if mg is None:
+        dispatch, combine, aux = jax.vmap(
+            lambda l: top1_routing(l, capacity)
+        )(logits)
+    else:
+        dispatch, combine, aux = route(logits, mg)
+    # [G, E, C, D]: per-group expert input buffers
+    xin = jnp.einsum("gsd,gsec->gecd", xg, dispatch)
+    h = activation(jnp.einsum("gecd,edh->gech", xin, w_in))
+    yout = jnp.einsum("gech,ehd->gecd", h, w_out)
+    y = jnp.einsum("gecd,gsec->gsd", yout, combine)
+    return y.reshape(N, -1), jnp.mean(aux)

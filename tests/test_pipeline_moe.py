@@ -135,6 +135,44 @@ class TestMoEOps:
         np.testing.assert_allclose(float(aux), 1.0, rtol=1e-5)
 
 
+class TestMoEGrouping:
+    def test_grouped_matches_manual_groups(self):
+        key = jax.random.key(0)
+        D, H, E, N = 4, 8, 2, 8
+        x = jax.random.normal(key, (N, D))
+        router = jax.random.normal(jax.random.key(1), (D, E))
+        w_in = jax.random.normal(jax.random.key(2), (E, D, H)) * 0.3
+        w_out = jax.random.normal(jax.random.key(3), (E, H, D)) * 0.3
+        y, _ = moe_ops.moe_ffn(
+            x, router, w_in, w_out, capacity_factor=4.0, group_size=4
+        )
+        halves = [
+            moe_ops.moe_ffn(
+                x[i : i + 4], router, w_in, w_out, capacity_factor=4.0,
+                group_size=4,
+            )[0]
+            for i in (0, 4)
+        ]
+        np.testing.assert_allclose(
+            np.asarray(y),
+            np.asarray(jnp.concatenate(halves)),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_bf16_rank_exactness(self):
+        # >256 tokens on one expert: ranks must stay exact under bf16
+        # activations (fp32 rank math inside routing)
+        N, E = 400, 2
+        logits = jnp.zeros((N, E), jnp.bfloat16).at[:, 0].set(1.0)
+        dispatch, _, _ = moe_ops.top1_routing(logits, capacity=N)
+        d = np.asarray(dispatch, np.float32)
+        # every token gets a DISTINCT slot on expert 0
+        slots = d[:, 0, :].argmax(-1)
+        assert len(set(slots.tolist())) == N
+        assert d.sum() == N
+
+
 class TestMoELayer:
     def _conf(self, E=4):
         with dsl.model() as g:
