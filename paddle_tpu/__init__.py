@@ -32,3 +32,41 @@ def init(**flags):
 
     for k, v in flags.items():
         _flags.set_flag(k, v)
+
+
+_LAZY = {
+    "dsl": "paddle_tpu.dsl",
+    "layers": "paddle_tpu.layers",
+    "models": "paddle_tpu.models",
+    "optimizers": "paddle_tpu.optimizers",
+    "evaluators": "paddle_tpu.evaluators",
+    "inference": "paddle_tpu.inference",
+    "api": "paddle_tpu.api",
+    "plot": "paddle_tpu.plot",
+    "image": "paddle_tpu.image",
+    "framework": "paddle_tpu.framework",
+    "dataset": "paddle_tpu.data.dataset",
+    "reader": "paddle_tpu.data.reader",
+}
+
+
+def __getattr__(name):
+    """Lazy submodule access (keeps `import paddle_tpu` light):
+    paddle_tpu.dsl, paddle_tpu.dataset.mnist, paddle_tpu.infer, ..."""
+    if name == "Network":
+        from paddle_tpu.network import Network
+
+        return Network
+    if name == "SGD":
+        from paddle_tpu.trainer import SGD
+
+        return SGD
+    if name == "infer":
+        from paddle_tpu.inference import infer
+
+        return infer
+    if name in _LAZY:
+        import importlib
+
+        return importlib.import_module(_LAZY[name])
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

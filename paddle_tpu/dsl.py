@@ -274,6 +274,12 @@ def seq_concat(a, b, name=None):
     return _add("seqconcat", [a, b], name=name)
 
 
+def sub_seq(x, offset, size, name=None):
+    """Dynamic per-example sub-span of a sequence (layers.py
+    sub_seq_layer; SubSequenceLayer.cpp). offset/size: [B] id layers."""
+    return _add("subseq", [x, offset, size], name=name, bias=False)
+
+
 def seq_reverse(x, name=None):
     return _add("seqreverse", [x], name=name)
 

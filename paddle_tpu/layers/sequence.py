@@ -222,3 +222,38 @@ class RotateLayer(Layer):
         y = x.reshape(lead + (h, w))
         y = jnp.flip(y.swapaxes(-1, -2), axis=-2)
         return arg.with_value(y.reshape(lead + (h * w,)))
+
+
+@LAYERS.register("subseq", "sub_seq")
+class SubSequenceLayer(Layer):
+    """Take a per-example sub-span of each sequence given dynamic offset
+    and size inputs (SubSequenceLayer.cpp: inputs [seq, offset, size]).
+    offset/size are [B] id args (one integer per sequence). TPU-first:
+    a clamped gather over the time axis plus a new seq_lens — static
+    shapes, so the output keeps the input's max length with padding
+    beyond each new length."""
+
+    def build(self, in_specs):
+        s = in_specs[0]
+        assert s.is_seq, "subseq needs a sequence input"
+        return Spec(dim=s.dim, is_seq=True, dtype=s.dtype), {}
+
+    def forward(self, params, inputs, ctx):
+        x, off, size = inputs
+        v = x.value
+        T = v.shape[1]
+        o = off.ids.reshape(-1)  # [B]
+        n = size.ids.reshape(-1)  # [B]
+        # clamp the span inside the real sequence; an offset at or past
+        # the end yields an EMPTY sequence, not a fabricated tail slice
+        in_range = o < x.seq_lens
+        o = jnp.clip(o, 0, jnp.maximum(x.seq_lens - 1, 0))
+        n = jnp.where(in_range, jnp.clip(n, 0, x.seq_lens - o), 0)
+        idx = o[:, None] + jnp.arange(T)[None, :]  # [B, T]
+        idx = jnp.clip(idx, 0, T - 1)
+        y = jnp.take_along_axis(
+            v, idx.reshape(idx.shape + (1,) * (v.ndim - 2)), axis=1
+        )
+        mask = (jnp.arange(T)[None, :] < n[:, None]).astype(v.dtype)
+        y = y * mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        return Arg(value=y, seq_lens=n.astype(jnp.int32))

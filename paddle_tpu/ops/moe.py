@@ -72,11 +72,6 @@ def top1_routing(
     return dispatch, combine, aux
 
 
-def _largest_divisor_leq(n: int, cap: int) -> int:
-    for g in range(min(cap, n), 0, -1):
-        if n % g == 0:
-            return g
-    return n
 
 
 def moe_ffn(
@@ -104,24 +99,36 @@ def moe_ffn(
     """
     N = x.shape[0]
     E = router_w.shape[1]
-    S = _largest_divisor_leq(N, group_size)
-    G = N // S
+    S = min(group_size, N)
+    # pad to a multiple of S with MASKED tokens so grouping never
+    # degenerates (a prime N must not collapse to one-token groups,
+    # which would disable capacity discipline entirely)
+    G = -(-N // S)
+    pad = G * S - N
+    mask = (
+        token_mask.astype(jnp.float32)
+        if token_mask is not None
+        else jnp.ones((N,), jnp.float32)
+    )
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]
+        )
+        mask = jnp.concatenate([mask, jnp.zeros((pad,), mask.dtype)])
     capacity = max(int(capacity_factor * S / E), 1)
     logits = (x @ router_w).reshape(G, S, E)
     xg = x.reshape(G, S, -1)
-    mg = token_mask.reshape(G, S) if token_mask is not None else None
-    route = jax.vmap(
+    mg = mask.reshape(G, S)
+    dispatch, combine, aux = jax.vmap(
         lambda l, m: top1_routing(l, capacity, token_mask=m)
-    )
-    if mg is None:
-        dispatch, combine, aux = jax.vmap(
-            lambda l: top1_routing(l, capacity)
-        )(logits)
-    else:
-        dispatch, combine, aux = route(logits, mg)
+    )(logits, mg)
     # [G, E, C, D]: per-group expert input buffers
     xin = jnp.einsum("gsd,gsec->gecd", xg, dispatch)
     h = activation(jnp.einsum("gecd,edh->gech", xin, w_in))
     yout = jnp.einsum("gech,ehd->gecd", h, w_out)
     y = jnp.einsum("gecd,gsec->gsd", yout, combine)
-    return y.reshape(N, -1), jnp.mean(aux)
+    # aux weighted by each group's REAL token count: all-padding groups
+    # contribute nothing, preserving the ungrouped loss semantics
+    real_g = jnp.sum(mg, axis=1)
+    aux = jnp.sum(aux * real_g) / jnp.maximum(jnp.sum(real_g), 1.0)
+    return y.reshape(G * S, -1)[:N], aux

@@ -325,3 +325,30 @@ def test_context_projection_values_and_grad():
         np.float32,
     )
     np.testing.assert_allclose(got, want)
+
+
+def test_sub_seq_layer():
+    with dsl.model() as g:
+        x = dsl.data("x", 2, is_seq=True)
+        off = dsl.data("off", 1, is_ids=True)
+        size = dsl.data("size", 1, is_ids=True)
+        dsl.sub_seq(x, off, size, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    xv = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 8, 2))
+    feed = {
+        "x": seq(xv, jnp.asarray([6], jnp.int32)),
+        "off": id_arg(jnp.asarray([2], jnp.int32)),
+        "size": id_arg(jnp.asarray([3], jnp.int32)),
+    }
+    outs, _ = net.forward(params, feed, outputs=["out"])
+    got = outs["out"]
+    assert np.asarray(got.seq_lens).tolist() == [3]
+    np.testing.assert_allclose(
+        np.asarray(got.value)[0, :3], np.asarray(xv)[0, 2:5]
+    )
+    np.testing.assert_allclose(np.asarray(got.value)[0, 3:], 0.0)
+    # span clamped inside the real sequence
+    feed["size"] = id_arg(jnp.asarray([99], jnp.int32))
+    outs, _ = net.forward(params, feed, outputs=["out"])
+    assert np.asarray(outs["out"].seq_lens).tolist() == [4]  # 6 - 2

@@ -291,3 +291,44 @@ class TestMoELayer:
         }
         loss, _ = jax.jit(net.loss_fn)(placed, feed)
         assert np.isfinite(float(loss))
+
+
+class TestMoEPrimeN:
+    def test_prime_token_count_keeps_capacity_discipline(self):
+        # N=7 (prime) with group_size=4: padded to 8, two groups of 4,
+        # capacity enforced within groups
+        D, E, N = 4, 2, 7
+        x = jax.random.normal(jax.random.key(0), (N, D))
+        router = jnp.zeros((D, E))  # tied logits -> argmax 0 for ALL
+        w_in = jax.random.normal(jax.random.key(1), (E, D, 8)) * 0.3
+        w_out = jax.random.normal(jax.random.key(2), (E, 8, D)) * 0.3
+        y, aux = moe_ops.moe_ffn(
+            x, router, w_in, w_out, capacity_factor=1.0, group_size=4
+        )
+        assert y.shape == (N, D)
+        # capacity = 1.0*4/2 = 2 per group -> at most 4 of 7 tokens
+        # produce non-zero output (the rest dropped by capacity)
+        nonzero = int((np.abs(np.asarray(y)).sum(-1) > 1e-7).sum())
+        assert nonzero <= 4
+        assert np.isfinite(float(aux))
+
+    def test_subseq_out_of_range_offset_empty(self):
+        from paddle_tpu import dsl
+        from paddle_tpu.core.arg import seq as seq_arg
+
+        with dsl.model() as g:
+            x = dsl.data("x", 2, is_seq=True)
+            off = dsl.data("off", 1, is_ids=True)
+            size = dsl.data("size", 1, is_ids=True)
+            dsl.sub_seq(x, off, size, name="out")
+        net = Network(g.conf)
+        params = net.init_params(jax.random.key(0))
+        xv = jnp.ones((1, 6, 2))
+        feed = {
+            "x": seq_arg(xv, jnp.asarray([4], jnp.int32)),
+            "off": id_arg(jnp.asarray([4], jnp.int32)),  # == seq_len
+            "size": id_arg(jnp.asarray([2], jnp.int32)),
+        }
+        outs, _ = net.forward(params, feed, outputs=["out"])
+        assert np.asarray(outs["out"].seq_lens).tolist() == [0]
+        np.testing.assert_allclose(np.asarray(outs["out"].value), 0.0)
