@@ -24,6 +24,9 @@ def main():
     # (paddle_tpu/network.py AMP policy) — the TPU-native equivalent of
     # the reference's fastest path
     _flags.set_flag("matmul_precision", "bfloat16")
+    # rbg PRNG: dropout mask generation off the critical path (~27%
+    # faster whole-step than threefry on this model)
+    jax.config.update("jax_default_prng_impl", "rbg")
 
     from paddle_tpu.core.arg import id_arg, non_seq
     from paddle_tpu.core.config import OptimizationConf

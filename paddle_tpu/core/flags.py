@@ -22,6 +22,10 @@ _DEFAULTS: dict[str, Any] = {
     # FP-exception trap (reference enables feenableexcept at trainer
     # start, trainer/TrainerMain.cpp:49): aborts on NaN-producing ops
     "trap_fp": False,
+    # PRNG implementation: None = jax default (threefry). "rbg" is
+    # substantially faster on TPU for dropout-heavy models (~27% whole
+    # -step on AlexNet) at the cost of weaker shard-stability guarantees
+    "prng_impl": None,
     "save_dir": None,
     "saving_period": 1,
     "save_only_one": False,

@@ -64,6 +64,12 @@ class SGD:
         jax.config.update(
             "jax_debug_nans", bool(_flags.get_flag("trap_fp"))
         )
+        # always sync (like trap_fp above): flag None restores the jax
+        # default rather than leaking a previous trainer's rbg setting
+        jax.config.update(
+            "jax_default_prng_impl",
+            _flags.get_flag("prng_impl") or "threefry2x32",
+        )
         key = _rng.root_key(seed or _flags.get_flag("seed"))
         init_key, self.step_key = jax.random.split(key)
         self.params = params if params is not None else self.net.init_params(init_key)

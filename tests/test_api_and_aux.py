@@ -275,3 +275,17 @@ class TestMultiNetworkRecurrentGroup:
             }))
         loss, _ = net.loss_fn(params, feed)
         assert np.isfinite(float(loss))
+
+
+class TestPrngFlag:
+    def test_prng_impl_flag(self):
+        from paddle_tpu.core import flags as F
+        from paddle_tpu.trainer import SGD
+
+        F.set_flag("prng_impl", "rbg")
+        try:
+            SGD(_clf_conf(), OptimizationConf(learning_method="sgd"))
+            assert jax.config.jax_default_prng_impl == "rbg"
+        finally:
+            F.set_flag("prng_impl", None)
+            jax.config.update("jax_default_prng_impl", "threefry2x32")

@@ -312,23 +312,3 @@ class TestMoEPrimeN:
         assert nonzero <= 4
         assert np.isfinite(float(aux))
 
-    def test_subseq_out_of_range_offset_empty(self):
-        from paddle_tpu import dsl
-        from paddle_tpu.core.arg import seq as seq_arg
-
-        with dsl.model() as g:
-            x = dsl.data("x", 2, is_seq=True)
-            off = dsl.data("off", 1, is_ids=True)
-            size = dsl.data("size", 1, is_ids=True)
-            dsl.sub_seq(x, off, size, name="out")
-        net = Network(g.conf)
-        params = net.init_params(jax.random.key(0))
-        xv = jnp.ones((1, 6, 2))
-        feed = {
-            "x": seq_arg(xv, jnp.asarray([4], jnp.int32)),
-            "off": id_arg(jnp.asarray([4], jnp.int32)),  # == seq_len
-            "size": id_arg(jnp.asarray([2], jnp.int32)),
-        }
-        outs, _ = net.forward(params, feed, outputs=["out"])
-        assert np.asarray(outs["out"].seq_lens).tolist() == [0]
-        np.testing.assert_allclose(np.asarray(outs["out"].value), 0.0)
