@@ -175,7 +175,12 @@ class RecurrentGroupLayer(Layer):
                 new_v = outs[m["layer"]].value
                 prev = carry[m["layer"]]
                 mm = m_t[:, None]
-                new_carry[m["layer"]] = mm * new_v + (1.0 - mm) * prev
+                # keep the carry dtype stable across steps: the float32
+                # mask (or a step op that upcasts) must not promote a
+                # bfloat16 carry under AMP — scan requires equal types
+                new_carry[m["layer"]] = (
+                    mm * new_v + (1.0 - mm) * prev
+                ).astype(prev.dtype)
             ys = []
             for o in self.out_links:
                 out_a = outs[o]

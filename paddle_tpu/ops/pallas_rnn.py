@@ -109,16 +109,26 @@ def _lstm_kernel(x_ref, w_ref, b_ref, lens_ref, y_ref, h_scr, c_scr):
         c = f * c_prev + i * cand
         o = jax.nn.sigmoid(go + wco * c)
         out = o * jnp.tanh(c)
-        m = (t < lens).astype(x_t.dtype)[:, None]
+        m = (t < lens).astype(jnp.float32)[:, None]
         h_scr[:] = m * out + (1 - m) * h_prev
         c_scr[:] = m * c + (1 - m) * c_prev
-        y_ref[:, t, :] = out * m
+        # state stays float32 in VMEM; the output ref may be bfloat16
+        # under AMP — cast at the store
+        y_ref[:, t, :] = (out * m).astype(y_ref.dtype)
         return 0
 
     lax.fori_loop(0, t_max, body, 0)
 
 
 def _lstm_fwd_kernel(x, w, b7, lens, *, interpret):
+    # Mosaic compiles this kernel for float32; under bf16 AMP upcast in
+    # (the cell math runs float32 internally regardless) and cast the
+    # sequence output back
+    orig = x.dtype
+    if orig == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        b7 = b7.astype(jnp.float32)
     bsz, t_max, h4 = x.shape
     h = h4 // 4
     bb = _batch_block(bsz, t_max, h4, h)
@@ -133,13 +143,17 @@ def _lstm_fwd_kernel(x, w, b7, lens, *, interpret):
             pl.BlockSpec((bb, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((bb, t_max, h), lambda i: (i, 0, 0)),
+        # NOTE: a bf16 output ref would halve output HBM traffic, but
+        # the Mosaic toolchain on this TPU fails to compile bf16 stores
+        # from this kernel (remote_compile 500) — so the kernel emits
+        # float32 and XLA converts after. Revisit when Mosaic allows it.
         out_shape=jax.ShapeDtypeStruct((bsz, t_max, h), x.dtype),
         scratch_shapes=[
             pltpu.VMEM((bb, h), jnp.float32),
             pltpu.VMEM((bb, h), jnp.float32),
         ],
         interpret=interpret,
-    )(x, w, b7, lens)
+    )(x, w, b7, lens).astype(orig)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
@@ -218,15 +232,23 @@ def _gru_kernel(x_ref, wg_ref, wc_ref, b_ref, lens_ref, y_ref, h_scr):
             )
         )
         out = u * h_prev + (1 - u) * c
-        m = (t < lens).astype(x_t.dtype)[:, None]
+        m = (t < lens).astype(jnp.float32)[:, None]
         h_scr[:] = m * out + (1 - m) * h_prev
-        y_ref[:, t, :] = out * m
+        # float32 VMEM state; output ref may be bfloat16 under AMP
+        y_ref[:, t, :] = (out * m).astype(y_ref.dtype)
         return 0
 
     lax.fori_loop(0, t_max, body, 0)
 
 
 def _gru_fwd_kernel(x, w_g, w_c, b, lens, *, interpret):
+    # same bf16-AMP upcast as the LSTM kernel
+    orig = x.dtype
+    if orig == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+        w_g = w_g.astype(jnp.float32)
+        w_c = w_c.astype(jnp.float32)
+        b = b.astype(jnp.float32)
     bsz, t_max, h3 = x.shape
     h = h3 // 3
     bb = _batch_block(bsz, t_max, h3, h)
@@ -242,10 +264,12 @@ def _gru_fwd_kernel(x, w_g, w_c, b, lens, *, interpret):
             pl.BlockSpec((bb, 1), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((bb, t_max, h), lambda i: (i, 0, 0)),
+        # float32 out + convert: see the Mosaic bf16-store note in
+        # _lstm_fwd_kernel
         out_shape=jax.ShapeDtypeStruct((bsz, t_max, h), x.dtype),
         scratch_shapes=[pltpu.VMEM((bb, h), jnp.float32)],
         interpret=interpret,
-    )(x, w_g, w_c, b[None, :], lens)
+    )(x, w_g, w_c, b[None, :], lens).astype(orig)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))

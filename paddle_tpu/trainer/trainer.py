@@ -34,6 +34,8 @@ from paddle_tpu.trainer.events import (
 
 log = logging.getLogger("paddle_tpu.trainer")
 
+_BASE_PRNG_IMPL = None  # captured at first SGD init (process default)
+
 
 class SGD:
     """Usage (mirrors paddle.v2.trainer.SGD):
@@ -64,11 +66,16 @@ class SGD:
         jax.config.update(
             "jax_debug_nans", bool(_flags.get_flag("trap_fp"))
         )
-        # always sync (like trap_fp above): flag None restores the jax
-        # default rather than leaking a previous trainer's rbg setting
+        # always sync (like trap_fp above): flag None restores whatever
+        # impl the PROCESS started with (env/JAX config), not a
+        # hardcoded default — so flag-less trainers never clobber a
+        # user's JAX_DEFAULT_PRNG_IMPL choice
+        global _BASE_PRNG_IMPL
+        if _BASE_PRNG_IMPL is None:
+            _BASE_PRNG_IMPL = jax.config.jax_default_prng_impl
         jax.config.update(
             "jax_default_prng_impl",
-            _flags.get_flag("prng_impl") or "threefry2x32",
+            _flags.get_flag("prng_impl") or _BASE_PRNG_IMPL,
         )
         key = _rng.root_key(seed or _flags.get_flag("seed"))
         init_key, self.step_key = jax.random.split(key)

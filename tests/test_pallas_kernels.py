@@ -108,3 +108,42 @@ class TestLayerIntegration:
         np.testing.assert_allclose(
             np.asarray(out["r"].value), np.asarray(ref["r"].value), atol=1e-5
         )
+
+
+def test_fused_kernels_accept_bfloat16():
+    # bf16 AMP inputs: kernels upcast internally and return bf16
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas_rnn
+
+    B, T, H = 4, 6, 8
+    x = jnp.ones((B, T, 4 * H), jnp.bfloat16)
+    w = jnp.full((H, 4 * H), 0.01, jnp.bfloat16)
+    gb = jnp.zeros((4 * H,), jnp.bfloat16)
+    wc = jnp.zeros((H,), jnp.bfloat16)
+    lens = jnp.full((B,), T, jnp.int32)
+    y = pallas_rnn.lstm_fused(x, w, gb, wc, wc, wc, lens, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    ref = pallas_rnn.lstm_ref(
+        x.astype(jnp.float32), w.astype(jnp.float32),
+        gb.astype(jnp.float32), wc.astype(jnp.float32),
+        wc.astype(jnp.float32), wc.astype(jnp.float32), lens,
+    )
+    np.testing.assert_allclose(
+        np.asarray(y, np.float32), np.asarray(ref), rtol=2e-2, atol=1e-2
+    )
+
+    w_g = jnp.full((H, 2 * H), 0.01, jnp.bfloat16)
+    w_c = jnp.full((H, H), 0.01, jnp.bfloat16)
+    b3 = jnp.zeros((3 * H,), jnp.bfloat16)
+    xg = jnp.ones((B, T, 3 * H), jnp.bfloat16)
+    yg = pallas_rnn.gru_fused(xg, w_g, w_c, b3, lens, interpret=True)
+    assert yg.dtype == jnp.bfloat16
+    gref = pallas_rnn.gru_ref(
+        xg.astype(jnp.float32), w_g.astype(jnp.float32),
+        w_c.astype(jnp.float32), b3.astype(jnp.float32), lens,
+    )
+    np.testing.assert_allclose(
+        np.asarray(yg, np.float32), np.asarray(gref), rtol=2e-2,
+        atol=1e-2,
+    )
