@@ -1,0 +1,8 @@
+"""v1 API compatibility: run 2017-era config files with minimal edits.
+
+`paddle_tpu.compat.layers_v1` exposes the trainer_config_helpers naming
+(`fc_layer(input=..., size=...)` keyword style) over the native DSL, so
+a `simple_mnist.py`-style config can be exec'd against this framework.
+"""
+
+from paddle_tpu.compat import layers_v1  # noqa: F401

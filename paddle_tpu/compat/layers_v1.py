@@ -1,0 +1,510 @@
+"""trainer_config_helpers-style layer functions.
+
+Reference: python/paddle/trainer_config_helpers/layers.py — `*_layer`
+functions taking `input=` keyword (single ref or list) plus attrs like
+`act=SomeActivation()`, `param_attr=ParamAttr(...)`. This module maps
+that surface onto paddle_tpu.dsl so v1-era config scripts run with
+minimal edits:
+
+    from paddle_tpu.compat.layers_v1 import *
+    with model_scope() as m:
+        img = data_layer(name="pixel", size=784)
+        hidden = fc_layer(input=img, size=128, act=ReluActivation())
+        out = fc_layer(input=hidden, size=10, act=SoftmaxActivation())
+        cost = classification_cost(
+            input=out, label=data_layer(name="label", size=10)
+        )
+
+Activation/ParamAttr objects mirror the reference's
+trainer_config_helpers.activations/attrs classes.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import dsl
+from paddle_tpu.core.config import ParameterConf
+
+model_scope = dsl.model
+
+__all__ = [
+    "model_scope",
+    "ParamAttr",
+    "data_layer",
+    "fc_layer",
+    "embedding_layer",
+    "addto_layer",
+    "concat_layer",
+    "dropout_layer",
+    "img_conv_layer",
+    "img_pool_layer",
+    "img_cmrnorm_layer",
+    "batch_norm_layer",
+    "maxout_layer",
+    "spp_layer",
+    "block_expand_layer",
+    "recurrent_layer",
+    "lstmemory",
+    "grumemory",
+    "pooling_layer",
+    "last_seq",
+    "first_seq",
+    "expand_layer",
+    "seq_concat_layer",
+    "seq_reshape_layer",
+    "sub_seq_layer",
+    "mixed_layer",
+    "tensor_layer",
+    "cos_sim",
+    "scaling_layer",
+    "slope_intercept_layer",
+    "interpolation_layer",
+    "linear_comb_layer",
+    "power_layer",
+    "clip_layer",
+    "row_conv_layer",
+    "conv_shift_layer",
+    "bilinear_interp_layer",
+    "selective_fc_layer",
+    "maxid_layer",
+    "sampling_id_layer",
+    "multiplex_layer",
+    "nce_layer",
+    "hsigmoid",
+    "crf_layer",
+    "crf_decoding_layer",
+    "ctc_layer",
+    "eos_layer",
+    "priorbox_layer",
+    "multibox_loss_layer",
+    "detection_output_layer",
+    "classification_cost",
+    "cross_entropy",
+    "square_error_cost",
+    "rank_cost",
+    "sum_cost",
+    "memory",
+    "recurrent_group",
+    # activations (attrs-style classes)
+    "LinearActivation",
+    "ReluActivation",
+    "SigmoidActivation",
+    "SoftmaxActivation",
+    "TanhActivation",
+    "STanhActivation",
+    "BReluActivation",
+    "SoftReluActivation",
+    "AbsActivation",
+    "SquareActivation",
+    "ExpActivation",
+]
+
+
+# ---- activations (trainer_config_helpers/activations.py) ----
+
+class _Act:
+    name = ""
+
+    def __init__(self):
+        pass
+
+
+def _make_act(cls_name, act_name):
+    return type(cls_name, (_Act,), {"name": act_name})
+
+
+LinearActivation = _make_act("LinearActivation", "")
+ReluActivation = _make_act("ReluActivation", "relu")
+SigmoidActivation = _make_act("SigmoidActivation", "sigmoid")
+SoftmaxActivation = _make_act("SoftmaxActivation", "softmax")
+TanhActivation = _make_act("TanhActivation", "tanh")
+STanhActivation = _make_act("STanhActivation", "stanh")
+BReluActivation = _make_act("BReluActivation", "brelu")
+SoftReluActivation = _make_act("SoftReluActivation", "softrelu")
+AbsActivation = _make_act("AbsActivation", "abs")
+SquareActivation = _make_act("SquareActivation", "square")
+ExpActivation = _make_act("ExpActivation", "exponential")
+
+
+def _act(a) -> str:
+    if a is None:
+        return ""
+    if isinstance(a, str):
+        return a
+    return a.name
+
+
+def _act_or(a, default: str) -> str:
+    """Default only when act was OMITTED: an explicit
+    LinearActivation() (name "") must stay linear — the standard
+    pre-batch-norm pattern depends on it."""
+    return default if a is None else _act(a)
+
+
+def ParamAttr(name=None, initial_std=None, initial_mean=0.0,
+              learning_rate=1.0, l1_rate=None, l2_rate=None,
+              is_static=False, sparse_update=False, **_):
+    """(trainer_config_helpers/attrs.py ParamAttr)."""
+    return ParameterConf(
+        name=name or "",
+        initial_std=initial_std,
+        initial_mean=initial_mean,
+        learning_rate=learning_rate,
+        decay_rate_l1=l1_rate,
+        decay_rate=l2_rate,
+        is_static=is_static,
+        sparse_update=sparse_update,
+    )
+
+
+def _one(input):
+    assert not isinstance(input, (list, tuple)), (
+        "this layer takes a single input"
+    )
+    return input
+
+
+def _many(input):
+    return list(input) if isinstance(input, (list, tuple)) else [input]
+
+
+# ---- layers ----
+
+def data_layer(name, size, height=None, width=None, depth=None,
+               is_ids=False, is_seq=False, has_subseq=False, **_):
+    """v1 data_layer; `is_ids`/`is_seq` are compat extensions (in v1 the
+    slot type came from the data provider declaration, which this
+    framework expresses on the data layer itself)."""
+    if height and width:
+        dim = (height, width, (depth or size // (height * width)))
+    else:
+        dim = size
+    return dsl.data(name, dim, is_seq=is_seq, is_ids=is_ids,
+                    has_subseq=has_subseq)
+
+
+def fc_layer(input, size, act=None, name=None, bias_attr=True,
+             param_attr=None, layer_attr=None, **_):
+    return dsl.fc(*_many(input), size=size, name=name, act=_act(act),
+                  bias=bool(bias_attr), param=param_attr)
+
+
+def embedding_layer(input, size, name=None, param_attr=None, **kw):
+    # v1 derives the vocabulary from the input layer's size — never
+    # guess a default (a too-small table silently corrupts training)
+    x = _one(input)
+    vocab = kw.get("vocab_size") or kw.get("dict_size")
+    if not vocab:
+        vocab = x.builder.conf.layer(x.name).size
+    assert vocab, "embedding_layer: set the word data_layer's size"
+    return dsl.embedding(x, size=size, vocab_size=vocab,
+                         name=name, param=param_attr)
+
+
+def addto_layer(input, act=None, name=None, bias_attr=False, **_):
+    return dsl.addto(*_many(input), name=name, act=_act(act),
+                     bias=bool(bias_attr))
+
+
+def concat_layer(input, name=None, **_):
+    return dsl.concat(*_many(input), name=name)
+
+
+def dropout_layer(input, dropout_rate, name=None, **_):
+    return dsl.dropout(_one(input), dropout_rate, name=name)
+
+
+def img_conv_layer(input, filter_size, num_filters, stride=1, padding=0,
+                   groups=1, dilation=1, act=None, name=None,
+                   bias_attr=True, param_attr=None, **_):
+    return dsl.conv(_one(input), num_filters, filter_size, stride=stride,
+                    padding=padding, groups=groups, dilation=dilation,
+                    name=name, act=_act_or(act, "relu"),
+                    bias=bool(bias_attr), param=param_attr)
+
+
+def img_pool_layer(input, pool_size, stride=None, padding=0,
+                   pool_type=None, name=None, **_):
+    pt = "max"
+    if pool_type is not None:
+        pt = getattr(pool_type, "name", str(pool_type)).lower()
+        pt = "avg" if "avg" in pt else "max"
+    return dsl.pool(_one(input), pool_size, stride=stride,
+                    padding=padding, pool_type=pt, name=name)
+
+
+def img_cmrnorm_layer(input, size=5, scale=1e-4, power=0.75, name=None,
+                      **_):
+    return dsl.lrn(_one(input), size=size, scale=scale, power=power,
+                   name=name)
+
+
+def batch_norm_layer(input, act=None, name=None,
+                     use_global_stats=False,
+                     moving_average_fraction=0.9, **_):
+    return dsl.batch_norm(
+        _one(input), name=name, act=_act(act),
+        use_global_stats=use_global_stats,
+        moving_average_fraction=moving_average_fraction,
+    )
+
+
+def maxout_layer(input, groups, name=None, **_):
+    return dsl.maxout(_one(input), groups, name=name)
+
+
+def spp_layer(input, pyramid_height=3, pool_type=None, name=None, **_):
+    pt = "max"
+    if pool_type is not None:
+        pn = getattr(pool_type, "name", str(pool_type)).lower()
+        pt = "avg" if "avg" in pn else "max"
+    return dsl.spp(_one(input), pyramid_height=pyramid_height,
+                   pool_type=pt, name=name)
+
+
+def block_expand_layer(input, block_x=1, block_y=1, stride_x=None,
+                       stride_y=None, padding_x=0, padding_y=0,
+                       name=None, **_):
+    return dsl.block_expand(
+        _one(input), (block_y, block_x),
+        stride=(stride_y or block_y, stride_x or block_x),
+        padding=(padding_y, padding_x), name=name,
+    )
+
+
+def recurrent_layer(input, size=None, act=None, reverse=False, name=None,
+                    bias_attr=True, **_):
+    return dsl.recurrent(_one(input), size, name=name,
+                         act=_act_or(act, "tanh"), reversed=reverse,
+                         bias=bool(bias_attr))
+
+
+def lstmemory(input, size=None, act=None, gate_act=None, state_act=None,
+              reverse=False, name=None, bias_attr=True, param_attr=None,
+              **_):
+    return dsl.lstmemory(
+        _one(input), size, name=name, act=_act_or(act, "tanh"),
+        gate_act=_act_or(gate_act, "sigmoid"),
+        state_act=_act_or(state_act, "tanh"), reversed=reverse,
+        bias=bool(bias_attr), param=param_attr,
+    )
+
+
+def grumemory(input, size=None, act=None, gate_act=None, reverse=False,
+              name=None, bias_attr=True, param_attr=None, **_):
+    return dsl.grumemory(
+        _one(input), size, name=name, act=_act_or(act, "tanh"),
+        gate_act=_act_or(gate_act, "sigmoid"), reversed=reverse,
+        bias=bool(bias_attr), param=param_attr,
+    )
+
+
+def pooling_layer(input, pooling_type=None, name=None, **_):
+    # v1 default is MaxPooling (trainer_config_helpers pooling_layer)
+    pt = "max"
+    if pooling_type is not None:
+        pn = getattr(pooling_type, "name", str(pooling_type)).lower()
+        for cand in ("sqrt", "avg", "max", "sum"):
+            if cand in pn:
+                pt = {"sqrt": "sqrt_average"}.get(cand, cand)
+                break
+    return dsl.seq_pool(_one(input), pool_type=pt, name=name)
+
+
+def last_seq(input, name=None, **_):
+    return dsl.last_seq(_one(input), name=name)
+
+
+def first_seq(input, name=None, **_):
+    return dsl.first_seq(_one(input), name=name)
+
+
+def expand_layer(input, expand_as, name=None, **_):
+    return dsl.expand(_one(input), expand_as, name=name)
+
+
+def seq_concat_layer(a, b, name=None, **_):
+    return dsl.seq_concat(a, b, name=name)
+
+
+def seq_reshape_layer(input, reshape_size, name=None, **_):
+    return dsl._add("seqreshape", [_one(input)], name=name, bias=False,
+                    size=reshape_size)
+
+
+def sub_seq_layer(input, offsets, sizes, name=None, **_):
+    return dsl.sub_seq(_one(input), offsets, sizes, name=name)
+
+
+def mixed_layer(size, input, act=None, name=None, bias_attr=True, **_):
+    return dsl.mixed(size, _many(input), name=name, act=_act(act),
+                     bias=bool(bias_attr))
+
+
+def tensor_layer(a, b, size, act=None, name=None, bias_attr=True, **_):
+    return dsl._add("tensor", [a, b], name=name, size=size,
+                    act=_act(act), bias=bool(bias_attr))
+
+
+def cos_sim(a, b, scale=1.0, name=None, **_):
+    return dsl.cos_sim(a, b, scale=scale, name=name)
+
+
+def scaling_layer(input, weight, name=None, **_):
+    return dsl.scaling(weight, _one(input), name=name)
+
+
+def slope_intercept_layer(input, slope=1.0, intercept=0.0, name=None, **_):
+    return dsl.slope_intercept(_one(input), slope, intercept, name=name)
+
+
+def interpolation_layer(input, weight, name=None, **_):
+    a, b = _many(input)
+    return dsl.interpolation(weight, a, b, name=name)
+
+
+def linear_comb_layer(weights, vectors, size, name=None, **_):
+    return dsl.linear_comb(weights, vectors, size, name=name)
+
+
+def power_layer(input, weight, name=None, **_):
+    return dsl.power(weight, _one(input), name=name)
+
+
+def clip_layer(input, min, max, name=None, **_):
+    return dsl.clip(_one(input), min=min, max=max, name=name)
+
+
+def row_conv_layer(input, context_len, name=None, param_attr=None, **_):
+    return dsl.row_conv(_one(input), context_len, name=name,
+                        param=param_attr)
+
+
+def conv_shift_layer(a, b, name=None, **_):
+    return dsl.conv_shift(a, b, name=name)
+
+
+def bilinear_interp_layer(input, out_size_x, out_size_y, name=None, **_):
+    return dsl.bilinear_interp(_one(input), out_size_x, out_size_y,
+                               name=name)
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       bias_attr=True, param_attr=None, **_):
+    return dsl.selective_fc(_one(input), select, size=size,
+                            act=_act(act), name=name,
+                            bias=bool(bias_attr), param=param_attr)
+
+
+def maxid_layer(input, name=None, **_):
+    return dsl._add("max_id", [_one(input)], name=name, bias=False)
+
+
+def sampling_id_layer(input, name=None, **_):
+    return dsl._add("sampling_id", [_one(input)], name=name, bias=False)
+
+
+def multiplex_layer(input, name=None, **_):
+    return dsl._add("multiplex", _many(input), name=name, bias=False)
+
+
+def nce_layer(input, label, num_classes, num_neg_samples=10, name=None,
+              param_attr=None, bias_attr=True, neg_distribution=None,
+              **_):
+    return dsl._add("nce", [*_many(input), label], name=name,
+                    size=num_classes, bias=bool(bias_attr),
+                    param=param_attr, num_neg_samples=num_neg_samples,
+                    neg_distribution=neg_distribution)
+
+
+def hsigmoid(input, label, num_classes, name=None, param_attr=None,
+             bias_attr=True, **_):
+    return dsl._add("hsigmoid", [*_many(input), label], name=name,
+                    size=num_classes, bias=bool(bias_attr),
+                    param=param_attr)
+
+
+def crf_layer(input, label, size, param_attr=None, name=None, **_):
+    return dsl.crf(input, label, num_tags=size, name=name,
+                   param=param_attr)
+
+
+def crf_decoding_layer(input, size, label=None, param_attr=None,
+                       name=None, **_):
+    return dsl.crf_decoding(input, num_tags=size, label=label,
+                            name=name, param=param_attr)
+
+
+def ctc_layer(input, label, size, blank=0, norm_by_times=False,
+              name=None, **_):
+    # v1 CTC consumes an already-softmaxed input (the config applies
+    # SoftmaxActivation on the fc) — do NOT softmax again
+    return dsl._add("ctc", [input, label], name=name or "cost",
+                    size=size, bias=False, blank=blank,
+                    norm_by_times=norm_by_times, apply_softmax=False)
+
+
+def eos_layer(input, eos_id, name=None, **_):
+    return dsl.eos_id(_one(input), eos_id, name=name)
+
+
+def priorbox_layer(input, image, min_size, max_size=(), aspect_ratio=(),
+                   variance=(0.1, 0.1, 0.2, 0.2), name=None, **_):
+    return dsl.priorbox(_one(input), image, min_size, max_size,
+                        aspect_ratio, variance, name=name)
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label,
+                        num_classes, overlap_threshold=0.5,
+                        neg_pos_ratio=3.0, neg_overlap=0.5, name=None,
+                        **kw):
+    gt_label = kw.get("gt_label", label)
+    return dsl.multibox_loss(priorbox, label, gt_label, input_loc,
+                             input_conf, num_classes, name=name,
+                             overlap_threshold=overlap_threshold,
+                             neg_pos_ratio=neg_pos_ratio,
+                             neg_overlap=neg_overlap)
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           name=None, **_):
+    return dsl.detection_output(priorbox, input_loc, input_conf,
+                                num_classes, name=name,
+                                nms_threshold=nms_threshold,
+                                nms_top_k=nms_top_k,
+                                keep_top_k=keep_top_k,
+                                confidence_threshold=confidence_threshold)
+
+
+# ---- costs ----
+
+def classification_cost(input, label, name=None, coeff=1.0, **_):
+    return dsl.classification_cost(input, label, name=name, coeff=coeff)
+
+
+def cross_entropy(input, label, name=None, coeff=1.0, **_):
+    return dsl.cross_entropy(input, label, name=name, coeff=coeff)
+
+
+def square_error_cost(input, label, name=None, coeff=1.0, **_):
+    return dsl.square_error(input, label, name=name, coeff=coeff)
+
+
+def rank_cost(left, right, label, name=None, coeff=1.0, **_):
+    return dsl.rank_cost(left, right, label, name=name, coeff=coeff)
+
+
+def sum_cost(input, name=None, coeff=1.0, **_):
+    return dsl.sum_cost(_one(input), name=name, coeff=coeff)
+
+
+# ---- recurrence ----
+
+def memory(name, size, boot_layer=None, **_):
+    return dsl.memory(name, size=size, boot_layer=boot_layer)
+
+
+def recurrent_group(step, input, name=None, reverse=False, **_):
+    return dsl.recurrent_group(step, _many(input), name=name,
+                               reversed=reverse)

@@ -14,5 +14,6 @@ from paddle_tpu.models.text import (  # noqa: F401
     seq2seq_attention_decoder,
     stacked_lstm_classifier,
 )
+from paddle_tpu.models.ctr import ctr_linear, ctr_wide_deep  # noqa: F401
 from paddle_tpu.models.gan import GAN, gan_conf  # noqa: F401
 from paddle_tpu.models.vae import vae_conf  # noqa: F401

@@ -1,0 +1,229 @@
+"""v1 compatibility layer + CTR sparse models (reference:
+python/paddle/trainer_config_helpers/layers.py surface;
+BASELINE config 'CTR wide-sparse logistic regression')."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.arg import id_arg, non_seq
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.models.ctr import ctr_linear, ctr_wide_deep
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+
+class TestV1Compat:
+    def test_quickstart_style_config(self):
+        # a v1-era text-CNN-ish config written in the old keyword style
+        from paddle_tpu.compat.layers_v1 import (
+            ReluActivation,
+            SoftmaxActivation,
+            TanhActivation,
+            classification_cost,
+            data_layer,
+            embedding_layer,
+            fc_layer,
+            model_scope,
+            pooling_layer,
+        )
+
+        with model_scope() as m:
+            words = None
+            from paddle_tpu import dsl
+
+            words = dsl.data("words", (1,), is_seq=True, is_ids=True)
+            lbl = data_layer(name="label", size=1)
+            emb = embedding_layer(input=words, size=16, vocab_size=100)
+            hidden = fc_layer(input=emb, size=32, act=TanhActivation())
+            pooled = pooling_layer(input=hidden)
+            out = fc_layer(input=pooled, size=2,
+                           act=SoftmaxActivation())
+            classification_cost(input=out, label=lbl)
+        net = Network(m.conf)
+        params = net.init_params(jax.random.key(0))
+        rng = np.random.default_rng(0)
+        feed = {
+            "words": id_arg(
+                jnp.asarray(rng.integers(0, 100, (4, 7)), jnp.int32),
+                jnp.asarray([7, 5, 3, 7], jnp.int32),
+            ),
+            "label": id_arg(jnp.asarray([0, 1, 0, 1], jnp.int32)),
+        }
+        loss, _ = net.loss_fn(params, feed)
+        assert np.isfinite(float(loss))
+
+    def test_mnist_style_mlp_trains(self):
+        from paddle_tpu.compat.layers_v1 import (
+            ReluActivation,
+            classification_cost,
+            data_layer,
+            fc_layer,
+            model_scope,
+        )
+
+        with model_scope() as m:
+            img = data_layer(name="pixel", size=64)
+            lbl = data_layer(name="label", size=1)
+            h = fc_layer(input=img, size=32, act=ReluActivation())
+            out = fc_layer(input=h, size=4)
+            classification_cost(input=out, label=lbl, name="cost")
+        # data_layer(label) produces a dense layer; feed ids directly
+        m.conf.layer("label").attrs["is_ids"] = True
+        net = Network(m.conf)
+        params = net.init_params(jax.random.key(0))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="adam", learning_rate=0.01),
+            net.param_confs,
+        )
+        st = opt.init_state(params)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((32, 64)).astype(np.float32)
+        y = (x[:, :4].sum(1) > 0).astype(np.int32) + 2 * (
+            x[:, 4:8].sum(1) > 0
+        ).astype(np.int32)
+        feed = {
+            "pixel": non_seq(jnp.asarray(x)),
+            "label": id_arg(jnp.asarray(y)),
+        }
+
+        @jax.jit
+        def step(params, st, i):
+            (l, _), g = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed)
+            return *opt.update(g, params, st, i), l
+
+        first = None
+        for i in range(40):
+            params, st, loss = step(params, st, i)
+            if i == 0:
+                first = float(loss)
+        assert float(loss) < first * 0.5
+
+
+def _ctr_batch(rng, B=32, F=1000, active=8):
+    feats = rng.integers(0, F, (B, active)).astype(np.int32)
+    # clickiness driven by presence of low feature ids
+    label = (feats < 50).any(axis=1).astype(np.int32)
+    lens = np.full(B, active, np.int32)
+    return feats, label, lens
+
+
+class TestCTR:
+    def _train(self, conf, steps=60):
+        net = Network(conf)
+        params = net.init_params(jax.random.key(0))
+        opt = create_optimizer(
+            OptimizationConf(learning_method="adam", learning_rate=0.02),
+            net.param_confs,
+        )
+        st = opt.init_state(params)
+        rng = np.random.default_rng(2)
+        feats, label, lens = _ctr_batch(rng)
+        feed = {
+            "features": id_arg(jnp.asarray(feats), jnp.asarray(lens)),
+            "label": id_arg(jnp.asarray(label)),
+        }
+
+        @jax.jit
+        def step(params, st, i):
+            (l, _), g = jax.value_and_grad(
+                net.loss_fn, has_aux=True
+            )(params, feed)
+            return *opt.update(g, params, st, i), l
+
+        first = None
+        for i in range(steps):
+            params, st, loss = step(params, st, i)
+            if i == 0:
+                first = float(loss)
+        return first, float(loss), net
+
+    def test_ctr_linear_learns(self):
+        conf = ctr_linear(feature_dim=1000)
+        first, last, net = self._train(conf)
+        assert last < first * 0.5, (first, last)
+        assert net.param_confs["wide_w"].sparse_update
+
+    def test_ctr_wide_deep_learns(self):
+        conf = ctr_wide_deep(feature_dim=1000, emb_dim=8, hidden=(16,))
+        first, last, _ = self._train(conf)
+        assert last < first * 0.5, (first, last)
+
+    def test_ctr_sharded_table(self):
+        # sharded=True: the table rows spread over the mesh model axis
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.parallel.sharding import Sharder
+
+        conf = ctr_linear(feature_dim=1024, sharded=True)
+        net = Network(conf)
+        devs = np.array(jax.devices()[:8]).reshape(1, 8)
+        mesh = Mesh(devs, ("data", "model"))
+        sh = Sharder(mesh)
+        spec = sh.spec("wide_w", net.param_confs["wide_w"])
+        assert spec == P("model", None)
+
+
+class TestV1CompatSemantics:
+    def test_linear_activation_not_defaulted(self):
+        from paddle_tpu.compat.layers_v1 import (
+            LinearActivation,
+            img_conv_layer,
+            model_scope,
+        )
+        from paddle_tpu import dsl as _dsl
+
+        with model_scope() as m:
+            img = _dsl.data("img", (8, 8, 3))
+            img_conv_layer(input=img, filter_size=3, num_filters=4,
+                           act=LinearActivation(), name="c1")
+            img_conv_layer(input=img, filter_size=3, num_filters=4,
+                           name="c2")
+        assert m.conf.layer("c1").active_type == ""  # explicit linear
+        assert m.conf.layer("c2").active_type == "relu"  # default
+
+    def test_data_layer_ids_and_embedding_vocab(self):
+        from paddle_tpu.compat.layers_v1 import (
+            data_layer,
+            embedding_layer,
+            model_scope,
+        )
+
+        with model_scope() as m:
+            words = data_layer(name="w", size=500, is_ids=True,
+                               is_seq=True)
+            emb = embedding_layer(input=words, size=8)
+        lc = m.conf.layer(emb.name)
+        assert lc.attrs["vocab_size"] == 500  # from the data layer size
+
+    def test_pooling_defaults_and_sqrt(self):
+        from paddle_tpu.compat.layers_v1 import (
+            data_layer,
+            pooling_layer,
+            model_scope,
+        )
+
+        class SqrtAvgPooling:
+            name = "sqrt"
+
+        with model_scope() as m:
+            x = data_layer(name="x", size=4, is_seq=True)
+            p1 = pooling_layer(input=x)
+            p2 = pooling_layer(input=x, pooling_type=SqrtAvgPooling())
+        assert m.conf.layer(p1.name).attrs["pool_type"] == "max"
+        assert m.conf.layer(p2.name).attrs["pool_type"] == "sqrt_average"
+
+    def test_ctc_no_double_softmax(self):
+        from paddle_tpu.compat.layers_v1 import (
+            ctc_layer,
+            data_layer,
+            model_scope,
+        )
+
+        with model_scope() as m:
+            x = data_layer(name="x", size=5, is_seq=True)
+            lbl = data_layer(name="l", size=1, is_ids=True, is_seq=True)
+            ctc_layer(input=x, label=lbl, size=5, name="ctc")
+        assert m.conf.layer("ctc").attrs["apply_softmax"] is False
