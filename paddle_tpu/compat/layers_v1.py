@@ -82,6 +82,10 @@ __all__ = [
     "square_error_cost",
     "rank_cost",
     "sum_cost",
+    "prelu_layer",
+    "gated_unit_layer",
+    "repeat_layer",
+    "kmax_sequence_score_layer",
     "memory",
     "recurrent_group",
     # activations (attrs-style classes)
@@ -167,6 +171,26 @@ def _many(input):
     return list(input) if isinstance(input, (list, tuple)) else [input]
 
 
+def _layer_size(ref):
+    return ref.builder.conf.layer(ref.name).size
+
+
+def _pool_type(obj, default="max"):
+    """Map a v1 pooling-type object/string to a pool kind."""
+    if obj is None:
+        return default
+    pn = getattr(obj, "name", str(obj)).lower()
+    for cand, mapped in (
+        ("sqrt", "sqrt_average"),
+        ("avg", "avg"),
+        ("max", "max"),
+        ("sum", "sum"),
+    ):
+        if cand in pn:
+            return mapped
+    return default
+
+
 # ---- layers ----
 
 def data_layer(name, size, height=None, width=None, depth=None,
@@ -224,12 +248,9 @@ def img_conv_layer(input, filter_size, num_filters, stride=1, padding=0,
 
 def img_pool_layer(input, pool_size, stride=None, padding=0,
                    pool_type=None, name=None, **_):
-    pt = "max"
-    if pool_type is not None:
-        pt = getattr(pool_type, "name", str(pool_type)).lower()
-        pt = "avg" if "avg" in pt else "max"
     return dsl.pool(_one(input), pool_size, stride=stride,
-                    padding=padding, pool_type=pt, name=name)
+                    padding=padding, pool_type=_pool_type(pool_type),
+                    name=name)
 
 
 def img_cmrnorm_layer(input, size=5, scale=1e-4, power=0.75, name=None,
@@ -253,12 +274,8 @@ def maxout_layer(input, groups, name=None, **_):
 
 
 def spp_layer(input, pyramid_height=3, pool_type=None, name=None, **_):
-    pt = "max"
-    if pool_type is not None:
-        pn = getattr(pool_type, "name", str(pool_type)).lower()
-        pt = "avg" if "avg" in pn else "max"
     return dsl.spp(_one(input), pyramid_height=pyramid_height,
-                   pool_type=pt, name=name)
+                   pool_type=_pool_type(pool_type), name=name)
 
 
 def block_expand_layer(input, block_x=1, block_y=1, stride_x=None,
@@ -273,7 +290,9 @@ def block_expand_layer(input, block_x=1, block_y=1, stride_x=None,
 
 def recurrent_layer(input, size=None, act=None, reverse=False, name=None,
                     bias_attr=True, **_):
-    return dsl.recurrent(_one(input), size, name=name,
+    x = _one(input)
+    size = size or _layer_size(x)  # v1 infers from the input
+    return dsl.recurrent(x, size, name=name,
                          act=_act_or(act, "tanh"), reversed=reverse,
                          bias=bool(bias_attr))
 
@@ -281,8 +300,10 @@ def recurrent_layer(input, size=None, act=None, reverse=False, name=None,
 def lstmemory(input, size=None, act=None, gate_act=None, state_act=None,
               reverse=False, name=None, bias_attr=True, param_attr=None,
               **_):
+    x = _one(input)
+    size = size or _layer_size(x) // 4  # v1: input is the 4h projection
     return dsl.lstmemory(
-        _one(input), size, name=name, act=_act_or(act, "tanh"),
+        x, size, name=name, act=_act_or(act, "tanh"),
         gate_act=_act_or(gate_act, "sigmoid"),
         state_act=_act_or(state_act, "tanh"), reversed=reverse,
         bias=bool(bias_attr), param=param_attr,
@@ -291,8 +312,10 @@ def lstmemory(input, size=None, act=None, gate_act=None, state_act=None,
 
 def grumemory(input, size=None, act=None, gate_act=None, reverse=False,
               name=None, bias_attr=True, param_attr=None, **_):
+    x = _one(input)
+    size = size or _layer_size(x) // 3  # v1: input is the 3h projection
     return dsl.grumemory(
-        _one(input), size, name=name, act=_act_or(act, "tanh"),
+        x, size, name=name, act=_act_or(act, "tanh"),
         gate_act=_act_or(gate_act, "sigmoid"), reversed=reverse,
         bias=bool(bias_attr), param=param_attr,
     )
@@ -300,14 +323,8 @@ def grumemory(input, size=None, act=None, gate_act=None, reverse=False,
 
 def pooling_layer(input, pooling_type=None, name=None, **_):
     # v1 default is MaxPooling (trainer_config_helpers pooling_layer)
-    pt = "max"
-    if pooling_type is not None:
-        pn = getattr(pooling_type, "name", str(pooling_type)).lower()
-        for cand in ("sqrt", "avg", "max", "sum"):
-            if cand in pn:
-                pt = {"sqrt": "sqrt_average"}.get(cand, cand)
-                break
-    return dsl.seq_pool(_one(input), pool_type=pt, name=name)
+    return dsl.seq_pool(_one(input), pool_type=_pool_type(pooling_type),
+                        name=name)
 
 
 def last_seq(input, name=None, **_):
@@ -437,8 +454,9 @@ def crf_decoding_layer(input, size, label=None, param_attr=None,
 def ctc_layer(input, label, size, blank=0, norm_by_times=False,
               name=None, **_):
     # v1 CTC consumes an already-softmaxed input (the config applies
-    # SoftmaxActivation on the fc) — do NOT softmax again
-    return dsl._add("ctc", [input, label], name=name or "cost",
+    # SoftmaxActivation on the fc) — do NOT softmax again. name=None
+    # auto-uniquifies (a fixed "cost" would collide across layers).
+    return dsl._add("ctc", [input, label], name=name,
                     size=size, bias=False, blank=blank,
                     norm_by_times=norm_by_times, apply_softmax=False)
 
@@ -457,7 +475,15 @@ def multibox_loss_layer(input_loc, input_conf, priorbox, label,
                         num_classes, overlap_threshold=0.5,
                         neg_pos_ratio=3.0, neg_overlap=0.5, name=None,
                         **kw):
-    gt_label = kw.get("gt_label", label)
+    """DIVERGENCE from v1: ground truth arrives as TWO layers — `label`
+    must be the [B,G,4] box data layer and `gt_label=` the [B,G] class
+    id layer (v1 packed both into one record stream, which a
+    static-shape feed cannot express)."""
+    gt_label = kw.get("gt_label")
+    assert gt_label is not None, (
+        "multibox_loss_layer: pass gt_label= (class-id data layer); "
+        "see docstring — boxes and labels are separate feeds here"
+    )
     return dsl.multibox_loss(priorbox, label, gt_label, input_loc,
                              input_conf, num_classes, name=name,
                              overlap_threshold=overlap_threshold,
@@ -497,6 +523,26 @@ def rank_cost(left, right, label, name=None, coeff=1.0, **_):
 
 def sum_cost(input, name=None, coeff=1.0, **_):
     return dsl.sum_cost(_one(input), name=name, coeff=coeff)
+
+
+def prelu_layer(input, partial_sum=0, name=None, param_attr=None, **_):
+    return dsl.prelu(_one(input), name=name, partial_sum=partial_sum,
+                     param=param_attr)
+
+
+def gated_unit_layer(input, size, act=None, name=None, bias_attr=True,
+                     **_):
+    return dsl.gated_unit(_one(input), size, act=_act(act), name=name,
+                          bias=bool(bias_attr))
+
+
+def repeat_layer(input, num_repeats, name=None, **_):
+    return dsl.repeat(_one(input), num_repeats, name=name)
+
+
+def kmax_sequence_score_layer(input, beam_size=1, name=None, **_):
+    return dsl.kmax_seq_score(_one(input), beam_size=beam_size,
+                              name=name)
 
 
 # ---- recurrence ----

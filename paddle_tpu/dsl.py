@@ -590,3 +590,23 @@ def img_conv_group(x, conv_num_filter, conv_filter_size,
         if conv_with_batchnorm:
             h = batch_norm(h, act=conv_act)
     return pool(h, pool_size, pool_stride, pool_type=pool_type)
+
+
+def prelu(x, name=None, partial_sum=0, param=None):
+    return _add("prelu", [x], name=name, bias=False, param=param,
+                partial_sum=partial_sum)
+
+
+def gated_unit(x, size, act="", name=None, bias=True):
+    return _add("gated_unit", [x], name=name, size=size, act=act,
+                bias=bias)
+
+
+def repeat(x, num_repeats, name=None):
+    return _add("repeat", [x], name=name, bias=False,
+                num_repeats=num_repeats)
+
+
+def kmax_seq_score(scores, beam_size=1, name=None):
+    return _add("kmax_seq_score", [scores], name=name, bias=False,
+                beam_size=beam_size)

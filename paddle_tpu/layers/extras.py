@@ -226,3 +226,110 @@ class FeatureMapExpandLayer(Layer):
             value=y.reshape(x.shape[:-1] + (-1,)),
             seq_lens=inputs[0].seq_lens,
         )
+
+
+@LAYERS.register("prelu")
+class PReluLayer(Layer):
+    """PReLU with learnable negative-side slopes (layers.py
+    prelu_layer). attrs partial_sum groups slopes: 0 = one slope per
+    element, size = one shared slope, else each slope covers
+    partial_sum consecutive elements (v1 semantics; channel-shared conv
+    PReLU = partial_sum of the spatial size)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        n = self.conf.attrs.get("partial_sum", 0) or 1
+        assert s.size % n == 0, (
+            f"prelu partial_sum {n} must divide input size {s.size}"
+        )
+        self._group = n
+        pcs = {"w0": self.weight_conf(0, (s.size // n,))}
+        # reference default slope 0.25 — unless the user configured init
+        if (
+            pcs["w0"].initial_std is None
+            and pcs["w0"].initial_strategy == "normal"
+            and pcs["w0"].initial_mean == 0.0
+        ):
+            pcs["w0"].initial_strategy = "constant"
+            pcs["w0"].initial_value = 0.25
+        self._spec = s
+        return s, pcs
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        v = x.value
+        a = jnp.repeat(params["w0"], self._group).reshape(self._spec.dim)
+        y = jnp.where(v >= 0, v, v * a)
+        return x.with_value(y)
+
+
+@LAYERS.register("gated_unit")
+class GatedUnitLayer(Layer):
+    """GLU: act(x W1) * sigmoid(x W2) (layers.py gated_unit_layer)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        out = self.conf.size
+        pcs = {
+            "w0": self.weight_conf(0, (s.size, out)),
+            "wg": self.weight_conf(0, (s.size, out)),
+        }
+        pcs["wg"].name = pcs["w0"].name + "_gate"
+        b = self.bias_conf((out,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(out,), is_seq=s.is_seq), pcs
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        h = jnp.dot(x.value, params["w0"])
+        if "b" in params:
+            h = h + params["b"]
+        h = self.apply_activation_and_dropout(h, ctx, x.seq_lens)
+        gate = jax.nn.sigmoid(jnp.dot(x.value, params["wg"]))
+        return Arg(value=h * gate, seq_lens=x.seq_lens)
+
+
+@LAYERS.register("repeat")
+class RepeatLayer(Layer):
+    """Tile the feature vector attrs["num_repeats"] times
+    (layers.py repeat_layer / FeatureMapExpand sibling)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        n = self.conf.attrs["num_repeats"]
+        self._n = n
+        return Spec(dim=(s.size * n,), is_seq=s.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        return Arg(
+            value=jnp.tile(x.value, (1,) * (x.value.ndim - 1) + (self._n,)),
+            seq_lens=x.seq_lens,
+        )
+
+
+@LAYERS.register("kmax_seq_score")
+class KmaxSeqScoreLayer(Layer):
+    """Indices of the top-k scores within each sequence
+    (KmaxSeqScoreLayer.cpp; layers.py kmax_sequence_score_layer).
+    Input: [B, T, 1] scores (seq); output ids [B, k] (positions),
+    padded positions excluded."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        self._k = self.conf.attrs.get("beam_size", 1)
+        return Spec(dim=(self._k,), is_ids=True), {}
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        v = x.value[..., 0] if x.value.ndim == 3 else x.value  # [B, T]
+        neg = jnp.finfo(v.dtype).min
+        masked = jnp.where(
+            jnp.arange(v.shape[1])[None, :] < x.seq_lens[:, None], v, neg
+        )
+        top_s, idx = jax.lax.top_k(masked, self._k)
+        # sequences shorter than k: pad with the reference's -1 sentinel
+        # rather than garbage padded-position ids
+        idx = jnp.where(top_s > neg, idx, -1)
+        return Arg(ids=idx.astype(jnp.int32))

@@ -227,3 +227,14 @@ class TestV1CompatSemantics:
             lbl = data_layer(name="l", size=1, is_ids=True, is_seq=True)
             ctc_layer(input=x, label=lbl, size=5, name="ctc")
         assert m.conf.layer("ctc").attrs["apply_softmax"] is False
+
+    def test_lstm_size_inferred_from_projection(self):
+        from paddle_tpu.compat.layers_v1 import (
+            data_layer, fc_layer, lstmemory, model_scope,
+        )
+
+        with model_scope() as m:
+            x = data_layer(name="x", size=8, is_seq=True)
+            proj = fc_layer(input=x, size=4 * 16)  # 4h projection
+            h = lstmemory(input=proj)  # size inferred = 16
+        assert m.conf.layer(h.name).size == 16

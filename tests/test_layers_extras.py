@@ -371,3 +371,100 @@ def test_sub_seq_out_of_range_offset_empty():
     outs, _ = net.forward(params, feed, outputs=["out"])
     assert np.asarray(outs["out"].seq_lens).tolist() == [0]
     np.testing.assert_allclose(np.asarray(outs["out"].value), 0.0)
+
+
+def test_prelu_layer():
+    dcs = [data_conf("x", 5)]
+    lc = LayerConf(name="pr", type="prelu", size=0,
+                   inputs=[InputConf("x")], bias=False)
+    check_layer_grad(lc, dcs, feed_for(dcs))
+    with dsl.model() as g:
+        x = dsl.data("x", 3)
+        dsl.prelu(x, name="out")
+    net = Network(g.conf)
+    params = dict(net.init_params(jax.random.key(0)))
+    params["_out.w0"] = jnp.asarray([0.1, 0.2, 0.5])
+    outs, _ = net.forward(
+        params, {"x": non_seq(jnp.asarray([[-1.0, -1.0, 2.0]]))},
+        outputs=["out"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs["out"].value), [[-0.1, -0.2, 2.0]], rtol=1e-6
+    )
+
+
+def test_gated_unit_layer():
+    dcs = [data_conf("x", 4)]
+    lc = LayerConf(name="gu", type="gated_unit", size=6,
+                   inputs=[InputConf("x")], active_type="tanh")
+    check_layer_grad(lc, dcs, feed_for(dcs))
+
+
+def test_repeat_layer():
+    with dsl.model() as g:
+        x = dsl.data("x", 2)
+        dsl.repeat(x, 3, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    outs, _ = net.forward(
+        params, {"x": non_seq(jnp.asarray([[1.0, 2.0]]))}, outputs=["out"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs["out"].value), [[1, 2, 1, 2, 1, 2]]
+    )
+
+
+def test_kmax_seq_score_layer():
+    with dsl.model() as g:
+        s = dsl.data("s", 1, is_seq=True)
+        dsl.kmax_seq_score(s, beam_size=2, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    scores = jnp.asarray([[[0.1], [0.9], [0.5], [0.7]]])
+    outs, _ = net.forward(
+        params,
+        {"s": seq(scores, jnp.asarray([3], jnp.int32))},  # pos 3 masked
+        outputs=["out"],
+    )
+    ids = np.asarray(outs["out"].ids)
+    assert ids.tolist() == [[1, 2]]  # 0.9 then 0.5; 0.7 beyond seq_len
+
+
+def test_prelu_conv_feature_map_and_groups():
+    # per-element slopes broadcast over an (H,W,C) feature map
+    with dsl.model() as g:
+        img = dsl.data("img", (4, 4, 2))
+        dsl.prelu(img, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    x = -jnp.ones((1, 4, 4, 2))
+    outs, _ = net.forward(params, {"img": non_seq(x)}, outputs=["out"])
+    np.testing.assert_allclose(np.asarray(outs["out"].value), -0.25)
+    # grouped slopes: partial_sum=4 on size 8 -> 2 shared slopes
+    with dsl.model() as g2:
+        v = dsl.data("v", 8)
+        dsl.prelu(v, partial_sum=4, name="out")
+    net2 = Network(g2.conf)
+    p2 = dict(net2.init_params(jax.random.key(0)))
+    assert p2["_out.w0"].shape == (2,)
+    p2["_out.w0"] = jnp.asarray([0.0, 1.0])
+    outs2, _ = net2.forward(
+        p2, {"v": non_seq(-jnp.ones((1, 8)))}, outputs=["out"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs2["out"].value), [[0, 0, 0, 0, -1, -1, -1, -1]]
+    )
+
+
+def test_kmax_short_sequence_sentinel():
+    with dsl.model() as g:
+        s = dsl.data("s", 1, is_seq=True)
+        dsl.kmax_seq_score(s, beam_size=4, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    scores = jnp.asarray([[[0.1], [0.9], [0.5], [0.7]]])
+    outs, _ = net.forward(
+        params, {"s": seq(scores, jnp.asarray([2], jnp.int32))},
+        outputs=["out"],
+    )
+    assert np.asarray(outs["out"].ids).tolist() == [[1, 0, -1, -1]]
