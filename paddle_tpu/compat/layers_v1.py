@@ -86,6 +86,7 @@ __all__ = [
     "gated_unit_layer",
     "repeat_layer",
     "kmax_sequence_score_layer",
+    "simple_attention",
     "memory",
     "recurrent_group",
     # activations (attrs-style classes)
@@ -543,6 +544,17 @@ def repeat_layer(input, num_repeats, name=None, **_):
 def kmax_sequence_score_layer(input, beam_size=1, name=None, **_):
     return dsl.kmax_seq_score(_one(input), beam_size=beam_size,
                               name=name)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None, size=None, **_):
+    return dsl.simple_attention(
+        encoded_sequence, encoded_proj, decoder_state, name=name,
+        weight_act=_act_or(weight_act, "tanh"),
+        transform_param=transform_param_attr,
+        softmax_param=softmax_param_attr, size=size,
+    )
 
 
 # ---- recurrence ----

@@ -336,16 +336,29 @@ def recurrent_group(step, inputs, name=None, reversed=False):
         sub._counts = parent._counts
         step_args = []
         in_links, static_links = [], []
-        for i in range(len(seq_ins)):
+
+        def _parent_size(ref):
+            try:
+                return parent.conf.layer(ref.name).size
+            except KeyError:
+                return 0
+
+        # stubs carry the parent layer's SIZE so size-dependent config
+        # helpers (simple_attention's proj width) work on step args;
+        # the group layer re-stamps dim/is_ids from the real inputs at
+        # build time
+        for i, r in enumerate(seq_ins):
             ln = f"@in_{i}"
-            sub.add(LayerConf(name=ln, type="data", size=0,
-                              attrs={"dim": (0,), "is_seq": False,
+            sz = _parent_size(r)
+            sub.add(LayerConf(name=ln, type="data", size=sz,
+                              attrs={"dim": (sz,), "is_seq": False,
                                      "is_ids": False}))
             in_links.append(ln)
-        for i in range(len(stat_ins)):
+        for i, r in enumerate(stat_ins):
             ln = f"@static_{i}"
-            sub.add(LayerConf(name=ln, type="data", size=0,
-                              attrs={"dim": (0,), "is_seq": False,
+            sz = _parent_size(r)
+            sub.add(LayerConf(name=ln, type="data", size=sz,
+                              attrs={"dim": (sz,), "is_seq": False,
                                      "is_ids": False}))
             static_links.append(ln)
         it_seq = iter(in_links)
@@ -590,6 +603,32 @@ def img_conv_group(x, conv_num_filter, conv_filter_size,
         if conv_with_batchnorm:
             h = batch_norm(h, act=conv_act)
     return pool(h, pool_size, pool_stride, pool_type=pool_type)
+
+
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     name=None, weight_act="tanh", transform_param=None,
+                     softmax_param=None, size=None):
+    """Bahdanau additive attention (networks.py:1298 simple_attention):
+    e_j = v·f(W s + U h_j), a = seq_softmax(e), c = sum_j a_j h_j.
+    `encoded_proj` carries U h_j precomputed once over the encoder;
+    call inside a recurrent_group step with `decoder_state` a memory.
+    Pass `size=` (the proj width) when `encoded_proj` enters the step
+    as a StaticInput (its in-step stub has no size)."""
+    name = name or current().uniq("simple_attention")
+    proj_size = size or current().conf.layer(encoded_proj.name).size
+    assert proj_size, (
+        "simple_attention: pass size= (encoded_proj enters the step as "
+        "a StaticInput, whose stub carries no size)"
+    )
+    proj_s = fc(decoder_state, size=proj_size, bias=False,
+                param=transform_param, name=f"{name}_dec_proj")
+    expanded = expand(proj_s, encoded_proj, name=f"{name}_expand")
+    mix = addto(encoded_proj, expanded, act=weight_act,
+                name=f"{name}_mix")
+    scores = fc(mix, size=1, bias=False, act="sequence_softmax",
+                param=softmax_param, name=f"{name}_score")
+    weighted = scaling(scores, encoded_sequence, name=f"{name}_weighted")
+    return seq_pool(weighted, pool_type="sum", name=f"{name}_context")
 
 
 def prelu(x, name=None, partial_sum=0, param=None):

@@ -121,15 +121,11 @@ def _attention_decoder_step(hidden, trg_vocab, emb_dim):
                             param=ParameterConf(name="trg_emb"),
                             name="trg_emb_lookup")
         prev = dsl.memory("dec_state", size=hidden)
-        # additive attention over the encoder sequence
-        # (networks.py:1298 simple_attention)
-        proj_s = dsl.fc(prev, size=hidden, bias=False, name="att_dec_proj")
-        expanded = dsl.expand(proj_s, enc, name="att_expand")
-        mix = dsl.addto(enc, expanded, act="tanh", name="att_mix")
-        scores = dsl.fc(mix, size=1, bias=False, act="sequence_softmax",
-                        name="att_score")
-        weighted = dsl.scaling(scores, enc, name="att_weighted")
-        ctx_vec = dsl.seq_pool(weighted, pool_type="sum", name="att_context")
+        # additive attention over the encoder sequence — the shared
+        # helper generates the exact layer names the previous inline
+        # block used, so checkpoints stay compatible
+        ctx_vec = dsl.simple_attention(enc, enc, prev, name="att",
+                                       size=hidden)
         s = dsl.fc(emb, prev, ctx_vec, size=hidden, act="tanh",
                    name="dec_state")
         return dsl.fc(s, size=trg_vocab, act="softmax", name="dec_prob")

@@ -91,3 +91,71 @@ def test_seq2seq_train_and_generate():
         got = seqs[i, 0, : lens[i, 0]].tolist()
         correct += got == want
     assert correct >= 6, f"only {correct}/8 correct"
+
+
+def test_dsl_simple_attention_in_group():
+    """dsl.simple_attention (networks.py:1298) builds the same additive
+    attention the seq2seq model inlines; a decoder step using it trains."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import dsl
+    from paddle_tpu.core.arg import id_arg
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.network import Network
+    from paddle_tpu.optimizers import create_optimizer
+
+    H, V = 16, 30
+    with dsl.model() as g:
+        src = dsl.data("src", (1,), is_seq=True, is_ids=True)
+        trg_in = dsl.data("trg_in", (1,), is_seq=True, is_ids=True)
+        trg_out = dsl.data("trg_out", (1,), is_seq=True, is_ids=True)
+        enc = dsl.simple_gru(
+            dsl.embedding(src, size=8, vocab_size=V), H
+        )
+        enc_proj = dsl.fc(enc, size=H, bias=False, name="enc_proj")
+
+        def step(word, enc_s, enc_p):
+            emb = dsl.embedding(word, size=8, vocab_size=V)
+            prev = dsl.memory("s", size=H)
+            ctxv = dsl.simple_attention(enc_s, enc_p, prev, name="att")
+            s = dsl.fc(emb, prev, ctxv, size=H, act="tanh", name="s")
+            return dsl.fc(s, size=V, act="softmax", name="prob")
+
+        dec = dsl.recurrent_group(
+            step,
+            [trg_in, dsl.StaticInput(enc), dsl.StaticInput(enc_proj)],
+            name="dec",
+        )
+        dsl.cross_entropy(dec, trg_out, name="cost")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(
+        OptimizationConf(learning_method="adam", learning_rate=0.02),
+        net.param_confs,
+    )
+    st = opt.init_state(params)
+    rng = np.random.default_rng(0)
+    B, T = 8, 6
+    lens = jnp.full((B,), T, jnp.int32)
+    body = rng.integers(2, V, (B, T)).astype(np.int32)
+    feed = {
+        "src": id_arg(jnp.asarray(body), lens),
+        "trg_in": id_arg(jnp.asarray(np.roll(body, 1, 1)), lens),
+        "trg_out": id_arg(jnp.asarray(body), lens),
+    }
+
+    @jax.jit
+    def train(params, st, i):
+        (l, _), grads = jax.value_and_grad(net.loss_fn, has_aux=True)(
+            params, feed
+        )
+        return *opt.update(grads, params, st, i), l
+
+    first = None
+    for i in range(40):
+        params, st, loss = train(params, st, i)
+        if i == 0:
+            first = float(loss)
+    assert float(loss) < first * 0.8, (first, float(loss))
