@@ -55,7 +55,13 @@ class BeamSearchDecoder:
         beam_size: int,
         max_length: int,
         logprob_fn: Optional[Callable] = None,
+        static_sizes: Optional[list] = None,
     ):
+        """`static_sizes` (optional, one int per static input) stamps
+        the static stubs' sizes so size-dependent config helpers (e.g.
+        dsl.simple_attention) work inside `step` at generation time the
+        same way they do inside a training recurrent_group (whose stubs
+        inherit sizes from the parent graph)."""
         from paddle_tpu import dsl
 
         self.bos_id, self.eos_id = bos_id, eos_id
@@ -71,10 +77,12 @@ class BeamSearchDecoder:
             )
             statics = []
             for i in range(n_static):
+                sz = (static_sizes or [0] * n_static)[i]
                 statics.append(
                     sub.add(LayerConf(name=f"@static_{i}", type="data",
-                                      size=0,
-                                      attrs={"dim": (0,), "is_seq": False,
+                                      size=sz,
+                                      attrs={"dim": (sz,),
+                                             "is_seq": False,
                                              "is_ids": False}))
                 )
             out = step(word, *statics)

@@ -611,14 +611,17 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
     """Bahdanau additive attention (networks.py:1298 simple_attention):
     e_j = v·f(W s + U h_j), a = seq_softmax(e), c = sum_j a_j h_j.
     `encoded_proj` carries U h_j precomputed once over the encoder;
-    call inside a recurrent_group step with `decoder_state` a memory.
-    Pass `size=` (the proj width) when `encoded_proj` enters the step
-    as a StaticInput (its in-step stub has no size)."""
+    call inside a recurrent_group step with `decoder_state` a memory
+    (stubs inherit the parent layer's size there). Inside a
+    BeamSearchDecoder step, pass `static_sizes=` to the decoder (or
+    `size=` here) — its standalone stubs have no parent to inherit
+    from."""
     name = name or current().uniq("simple_attention")
     proj_size = size or current().conf.layer(encoded_proj.name).size
     assert proj_size, (
-        "simple_attention: pass size= (encoded_proj enters the step as "
-        "a StaticInput, whose stub carries no size)"
+        "simple_attention: encoded_proj has no size here — inside a "
+        "BeamSearchDecoder step pass static_sizes= to the decoder, or "
+        "size= to this call"
     )
     proj_s = fc(decoder_state, size=proj_size, bias=False,
                 param=transform_param, name=f"{name}_dec_proj")

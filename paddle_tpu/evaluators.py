@@ -62,7 +62,10 @@ class Evaluator:
 
 @EVALUATORS.register("classification_error")
 class ClassificationErrorEvaluator(Evaluator):
-    """(Evaluator.cpp:172 ClassificationErrorEvaluator)."""
+    """(Evaluator.cpp:172 ClassificationErrorEvaluator). conf "top_k"
+    (the reference's classification_threshold/num_results family):
+    a prediction counts as correct when the label is among the k
+    highest-scoring classes (default 1)."""
 
     def start(self):
         self.wrong = 0.0
@@ -71,8 +74,15 @@ class ClassificationErrorEvaluator(Evaluator):
     def add_batch(self, outs, feed):
         pred = self._get(outs, feed, "input")
         label = self._get(outs, feed, "label")
+        k = int(self.conf.get("top_k", 1))
         p, l, w = self._masked_pairs(pred, label)
-        hit = (np.argmax(p, axis=-1) == l).astype(np.float64)
+        if k <= 1:
+            hit = (np.argmax(p, axis=-1) == l).astype(np.float64)
+        else:
+            topk = np.argpartition(-p, min(k, p.shape[-1] - 1), axis=-1)[
+                :, :k
+            ]
+            hit = (topk == l[:, None]).any(axis=-1).astype(np.float64)
         self.wrong += float(((1.0 - hit) * w).sum())
         self.total += float(w.sum())
 

@@ -93,3 +93,40 @@ def test_beam_logprob_hook():
     out = np.asarray(seqs)[0, 0, : int(np.asarray(lens)[0, 0])]
     assert banned not in out.tolist()
     assert out.tolist() == [3, eos]
+
+
+def test_decoder_static_sizes_enable_simple_attention():
+    """A step using dsl.simple_attention works under BeamSearchDecoder
+    when static_sizes stamps the stub widths (parity with the training
+    recurrent_group path)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import dsl
+    from paddle_tpu.beam_search import BeamSearchDecoder
+    from paddle_tpu.core.arg import seq
+
+    H, V = 8, 12
+
+    def step(word, enc_s, enc_p):
+        emb = dsl.embedding(word, size=4, vocab_size=V)
+        prev = dsl.memory("s", size=H)
+        ctxv = dsl.simple_attention(enc_s, enc_p, prev, name="att")
+        s = dsl.fc(emb, prev, ctxv, size=H, act="tanh", name="s")
+        return dsl.fc(s, size=V, act="softmax", name="prob")
+
+    dec = BeamSearchDecoder(step, n_static=2, bos_id=0, eos_id=1,
+                            beam_size=2, max_length=5,
+                            static_sizes=[H, H])
+    rng = np.random.default_rng(0)
+    B, T = 2, 4
+    enc = seq(jnp.asarray(rng.standard_normal((B, T, H)), jnp.float32),
+              jnp.asarray([T, T], jnp.int32))
+    params = {
+        name: jnp.asarray(rng.standard_normal(pc.dims) * 0.1, jnp.float32)
+        for name, pc in dec.param_confs([enc, enc]).items()
+    }
+    seqs, lens, scores = dec.generate(params, [enc, enc])
+    assert seqs.shape == (B, 2, 5)
+    assert np.asarray(lens).max() <= 5

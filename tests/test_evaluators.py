@@ -194,3 +194,25 @@ def test_printers_capture_lines():
     assert "no grad tap" in lines[-1]
     ev.add_batch({"out@GRAD": non_seq(p)}, {"out": non_seq(p)})
     assert "no grad tap" not in lines[-1]
+
+
+def test_classification_error_top_k():
+    from paddle_tpu.core.arg import Arg
+    import jax.numpy as jnp
+
+    ev = create_evaluator(
+        {"type": "classification_error", "input": "out", "label": "y",
+         "top_k": 2}
+    )
+    # row 0: label 2 is 2nd-highest -> top-2 correct, top-1 wrong
+    # row 1: label 2 is 3rd-highest -> wrong at both
+    p = jnp.asarray([[0.5, 0.1, 0.3, 0.1], [0.1, 0.6, 0.1, 0.2]])
+    y = jnp.asarray([2, 2])
+    ev.add_batch({"out": Arg(value=p)}, {"y": Arg(ids=y)})
+    assert ev.result() == 0.5  # first correct (top-2), second wrong
+
+    ev1 = create_evaluator(
+        {"type": "classification_error", "input": "out", "label": "y"}
+    )
+    ev1.add_batch({"out": Arg(value=p)}, {"y": Arg(ids=y)})
+    assert ev1.result() == 1.0
