@@ -64,6 +64,10 @@ class BeamSearchDecoder:
         inherit sizes from the parent graph)."""
         from paddle_tpu import dsl
 
+        assert static_sizes is None or len(static_sizes) == n_static, (
+            f"static_sizes needs one entry per static input "
+            f"({len(static_sizes)} given, n_static={n_static})"
+        )
         self.bos_id, self.eos_id = bos_id, eos_id
         self.k = beam_size
         self.max_length = max_length

@@ -15,5 +15,6 @@ from paddle_tpu.layers import (  # noqa: F401
     recurrent_group,
     sampling,
     sequence,
+    steps,
     structured,
 )

@@ -123,7 +123,7 @@ class AddtoLayer(Layer):
         return inputs[0].with_value(y)
 
 
-@LAYERS.register("concat")
+@LAYERS.register("concat", "concat2")
 class ConcatLayer(Layer):
     """Feature-axis concat (gserver/layers/ConcatenateLayer.cpp). When all
     inputs are same-H,W image specs, concatenates channels and keeps the
@@ -339,7 +339,7 @@ class TensorLayer(Layer):
         return inputs[0].with_value(y)
 
 
-@LAYERS.register("outer_prod")
+@LAYERS.register("outer_prod", "out_prod")
 class OuterProdLayer(Layer):
     """Outer product of two vectors flattened (OuterProdLayer.cpp)."""
 

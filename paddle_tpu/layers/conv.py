@@ -84,7 +84,7 @@ class ConvLayer(Layer):
         return Arg(value=y, seq_lens=arg.seq_lens)
 
 
-@LAYERS.register("exconvt", "conv_trans")
+@LAYERS.register("exconvt", "conv_trans", "cudnn_convt")
 class ConvTransLayer(Layer):
     """Transposed conv (gserver/layers/ConvTransLayer.cpp et al.)."""
 

@@ -134,7 +134,7 @@ class RankingCost(CostLayerBase):
         return self._reduce(per, a)
 
 
-@LAYERS.register("huber_classification", "huber-two-class")
+@LAYERS.register("huber_classification", "huber-two-class", "huber")
 class HuberTwoClassCost(CostLayerBase):
     """Huber loss for 2-class classification with {-1,1} margin
     (CostLayer.cpp HuberTwoClassification): input 1-D score, label 0/1."""
@@ -191,3 +191,24 @@ class SoftmaxLayer(Layer):
     def forward(self, params, inputs, ctx):
         (arg,) = inputs
         return arg.with_value(jax.nn.softmax(arg.value, axis=-1))
+
+
+@LAYERS.register("multi_class_cross_entropy_with_selfnorm")
+class MultiClassCrossEntropyWithSelfNorm(CostLayerBase):
+    """CE over probabilities plus softmax_selfnorm_alpha * log(Z)^2
+    (CostLayer.cpp MultiClassCrossEntropyWithSelfNorm): pushes the
+    partition function toward 1 so inference can skip normalization."""
+
+    def forward(self, params, inputs, ctx):
+        prob, label = inputs
+        z = jnp.sum(prob.value, axis=-1)
+        p = jnp.take_along_axis(
+            prob.value / jnp.maximum(z, _EPS)[..., None],
+            label.ids[..., None],
+            axis=-1,
+        )[..., 0]
+        alpha = self.conf.attrs.get("softmax_selfnorm_alpha", 0.1)
+        per = -jnp.log(jnp.maximum(p, _EPS)) + alpha * jnp.square(
+            jnp.log(jnp.maximum(z, _EPS))
+        )
+        return self._reduce(per, prob)

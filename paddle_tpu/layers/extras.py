@@ -333,3 +333,85 @@ class KmaxSeqScoreLayer(Layer):
         # rather than garbage padded-position ids
         idx = jnp.where(top_s > neg, idx, -1)
         return Arg(ids=idx.astype(jnp.int32))
+
+
+@LAYERS.register("cos_vm")
+class CosSimVecMatLayer(Layer):
+    """Cosine similarity between a vector and each row of a matrix
+    (CosSimVecMatLayer.cpp, NTM content addressing):
+    inputs [v (B,D), m (B, W*D)]; out[b,i] = scale * cos(v[b], m[b,i,:]).
+    size = W."""
+
+    def build(self, in_specs):
+        sv, sm = in_specs
+        w = self.conf.size
+        assert sm.size == w * sv.size, (
+            f"cos_vm: {sm.size} != {w} * {sv.size}"
+        )
+        self._w = w
+        return Spec(dim=(w,)), {}
+
+    def forward(self, params, inputs, ctx):
+        v, m = inputs[0].value, inputs[1].value
+        mm = m.reshape(m.shape[0], self._w, -1)  # [B, W, D]
+        scale = self.conf.attrs.get("scale", 1.0)
+        dot = jnp.einsum("bd,bwd->bw", v, mm)
+        # safe norms: linalg.norm has a NaN vjp at exactly 0, and NTM
+        # memory rows START at zero — sqrt(sum + eps) keeps grads finite
+        nv = jnp.sqrt(jnp.sum(jnp.square(v), -1, keepdims=True) + 1e-12)
+        nm = jnp.sqrt(jnp.sum(jnp.square(mm), -1) + 1e-12)
+        return Arg(value=scale * dot / (nv * nm))
+
+
+@LAYERS.register("data_norm")
+class DataNormLayer(Layer):
+    """Normalize inputs with PRECOMPUTED statistics held as a static
+    parameter (DataNormLayer.cpp): attrs data_norm_strategy in
+    {"z-score", "min-max", "decimal-scaling"}; the stats parameter is
+    [3, D] rows (mean|min|decimal-scale, std|max-min|_) supplied by the
+    user (is_static, like the reference loads them from file)."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        pc = self.weight_conf(0, (3, s.size))
+        pc.is_static = True
+        pc.initial_strategy = "zero"
+        return s, {"w0": pc}
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        stats = params["w0"]
+        strat = self.conf.attrs.get("data_norm_strategy", "z-score")
+        v = x.value
+
+        def denom(row):
+            # unloaded stats (all zeros) must mean IDENTITY, not a 1e8
+            # blow-up from a zero divisor
+            return jnp.where(row == 0, 1.0, row)
+
+        if strat in ("z-score", "min-max"):
+            # shared affine form; rows differ: (mean, std) vs (min,
+            # max-min)
+            y = (v - stats[0]) / denom(stats[1])
+        elif strat == "decimal-scaling":
+            y = v / denom(stats[0])
+        else:
+            raise KeyError(f"unknown data_norm_strategy {strat!r}")
+        return x.with_value(y)
+
+
+@LAYERS.register("print")
+class PrintLayer(Layer):
+    """Identity that prints its input during execution
+    (PrintLayer.cpp) — jax.debug.print, so it works inside jit."""
+
+    def build(self, in_specs):
+        return in_specs[0], {}
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        v = x.value if x.value is not None else x.ids
+        # name passed as an ARG: a '{' in a layer name must not be
+        # treated as a format field
+        jax.debug.print("{}: {}", self.name, v)
+        return x

@@ -140,7 +140,7 @@ class SamplingIdLayer(Layer):
         return Arg(ids=ids, seq_lens=arg.seq_lens)
 
 
-@LAYERS.register("max_id")
+@LAYERS.register("max_id", "maxid")
 class MaxIdLayer(Layer):
     """Argmax id (MaxIdLayer.cpp)."""
 

@@ -16,7 +16,7 @@ from paddle_tpu.layers.base import Layer, Spec
 from paddle_tpu.ops import sequence_ops as sops
 
 
-@LAYERS.register("seqpool", "sequence_pool")
+@LAYERS.register("seqpool", "sequence_pool", "average", "max")
 class SequencePoolLayer(Layer):
     """Pool a sequence to one vector per example, or each sub-sequence to
     one timestep. attrs: pool_type in {sum, average, max, sqrt_average},
@@ -40,7 +40,14 @@ class SequencePoolLayer(Layer):
 
     def forward(self, params, inputs, ctx):
         (arg,) = inputs
-        kind = self.conf.attrs.get("pool_type", "sum")
+        # the reference's AverageLayer/MaxLayer are separate types with
+        # the pool kind baked into the type name
+        default = (
+            self.conf.type
+            if self.conf.type in ("average", "max")
+            else "sum"
+        )
+        kind = self.conf.attrs.get("pool_type", default)
         level = self.conf.attrs.get("level", "seq")
         if level == "subseq":
             op_map = {

@@ -1,0 +1,111 @@
+"""Single-step recurrent cells for custom recurrence inside groups.
+
+Reference: gserver/layers/GruStepLayer.cpp:22-36 and
+LstmStepLayer.cpp:45 — the cell math of GatedRecurrentLayer/LstmLayer
+exposed as one-timestep layers so a recurrent_group step net can build
+custom recurrences (the seqToseq demo's decoder pattern). Parameter
+layouts match the sequence layers (recurrent.py), so weights transfer.
+
+Divergence: LstmStepLayer exposed its cell state via get_output_layer;
+here lstm_step emits it as the extra output `<name>@state`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+from paddle_tpu.ops import activations
+
+
+@LAYERS.register("gru_step", "gru_step_naive")
+class GruStepLayer(Layer):
+    """inputs: [xg (B, 3h: update|reset|candidate pre-projection),
+    prev_out (B, h)]; output h_t (GruStepLayer.cpp:22-36)."""
+
+    def build(self, in_specs):
+        sx, sp = in_specs
+        h = self.conf.size or sp.size
+        assert sx.size == 3 * h, (
+            f"gru_step input must be 3*size, got {sx.size} vs h={h}"
+        )
+        pcs = {
+            "w0": self.weight_conf(0, (h, 2 * h)),
+            "w_c": self.weight_conf(0, (h, h)),
+        }
+        pcs["w_c"].name = f"_{self.name}.wc"
+        b = self.bias_conf((3 * h,))
+        if b is not None:
+            pcs["b"] = b
+        self._h = h
+        return Spec(dim=(h,)), pcs
+
+    def forward(self, params, inputs, ctx):
+        xg, prev = inputs
+        h = self._h
+        act = activations.get(self.conf.active_type or "tanh")
+        gate_act = activations.get(
+            self.conf.attrs.get("active_gate_type", "sigmoid")
+        )
+        x = xg.value
+        p = prev.value
+        b = params.get("b", jnp.zeros((3 * h,), x.dtype))
+        gur = jnp.dot(p, params["w0"])  # [B, 2h]
+        u = gate_act(x[:, :h] + gur[:, :h] + b[:h])
+        r = gate_act(x[:, h : 2 * h] + gur[:, h:] + b[h : 2 * h])
+        c = act(x[:, 2 * h :] + jnp.dot(r * p, params["w_c"]) + b[2 * h :])
+        out = u * p + (1.0 - u) * c
+        return Arg(value=out)
+
+
+@LAYERS.register("lstm_step")
+class LstmStepLayer(Layer):
+    """inputs: [x4 (B, 4h gate pre-projection), prev_h (B, h),
+    prev_c (B, h)]; output h_t, extra `<name>@state` = c_t
+    (LstmStepLayer.cpp; cell math of LstmLayer/hl_cuda_lstm)."""
+
+    def build(self, in_specs):
+        sx = in_specs[0]
+        h = self.conf.size or in_specs[1].size
+        assert sx.size == 4 * h, (
+            f"lstm_step input must be 4*size, got {sx.size} vs h={h}"
+        )
+        pcs = {"w0": self.weight_conf(0, (h, 4 * h))}
+        b = self.bias_conf((7 * h,))  # 4h gate biases + 3h peepholes
+        if b is not None:
+            pcs["b"] = b
+        self._h = h
+        return Spec(dim=(h,)), pcs
+
+    def extra_output_specs(self):
+        return {f"{self.name}@state": Spec(dim=(self._h,))}
+
+    def forward(self, params, inputs, ctx):
+        x4, prev_h, prev_c = inputs
+        h = self._h
+        act = activations.get(self.conf.active_type or "tanh")
+        gate_act = activations.get(
+            self.conf.attrs.get("active_gate_type", "sigmoid")
+        )
+        state_act = activations.get(
+            self.conf.attrs.get("active_state_type", "tanh")
+        )
+        b = params.get("b", jnp.zeros((7 * h,), x4.value.dtype))
+        gb, wci, wcf, wco = (
+            b[: 4 * h],
+            b[4 * h : 5 * h],
+            b[5 * h : 6 * h],
+            b[6 * h :],
+        )
+        g = x4.value + jnp.dot(prev_h.value, params["w0"]) + gb
+        gi, gf, gg, go = jnp.split(g, 4, axis=-1)
+        c_prev = prev_c.value
+        i = gate_act(gi + wci * c_prev)
+        f = gate_act(gf + wcf * c_prev)
+        c = f * c_prev + i * act(gg)
+        o = gate_act(go + wco * c)
+        out = o * state_act(c)
+        self._extra_outs = {f"{self.name}@state": Arg(value=c)}
+        return Arg(value=out)

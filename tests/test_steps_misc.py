@@ -1,0 +1,207 @@
+"""Step cells (gru_step/lstm_step), cos_vm, data_norm, selfnorm CE,
+print layer, and reference-name aliases (GruStepLayer.cpp,
+LstmStepLayer.cpp, CosSimVecMatLayer.cpp, DataNormLayer.cpp,
+CostLayer.cpp MultiClassCrossEntropyWithSelfNorm, PrintLayer.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import dsl
+from paddle_tpu.core.arg import Arg, id_arg, non_seq
+from paddle_tpu.core.config import InputConf, LayerConf, OptimizationConf
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+from paddle_tpu.testing import check_layer_grad, data_conf, random_arg
+
+RNG = lambda: np.random.default_rng(5)
+
+
+def feed_for(dcs, batch=4, max_len=5):
+    rng = RNG()
+    return {
+        dc.name: random_arg(
+            rng, dc.attrs["dim"], batch=batch,
+            is_seq=dc.attrs["is_seq"], max_len=max_len,
+            is_ids=dc.attrs["is_ids"], vocab=10,
+        )
+        for dc in dcs
+    }
+
+
+def test_gru_step_matches_grumemory():
+    """A recurrent_group whose step uses gru_step equals the fused
+    grumemory layer (same weights, same layout)."""
+    H = 6
+    with dsl.model() as g:
+        x = dsl.data("x", 3 * H, is_seq=True)
+        full = dsl.grumemory(x, H, name="gru", bias=False)
+
+        def step(xt):
+            prev = dsl.memory("s", size=H)
+            return dsl._add("gru_step", [xt, prev], name="s", size=H,
+                            bias=False)
+
+        stepped = dsl.recurrent_group(step, [x], name="rg")
+    net = Network(g.conf)
+    params = dict(net.init_params(jax.random.key(0)))
+    # share the step weights with the fused layer's
+    params["_s.w0"] = params["_gru.w0"]
+    params["_s.wc"] = params["_gru.wc"]
+    rng = RNG()
+    xv = jnp.asarray(rng.standard_normal((2, 5, 3 * H)), jnp.float32)
+    lens = jnp.asarray([5, 3], jnp.int32)
+    from paddle_tpu.core.arg import seq
+
+    outs, _ = net.forward(
+        params, {"x": seq(xv, lens)}, outputs=["gru", "rg"]
+    )
+    a = np.asarray(outs["gru"].value)
+    b = np.asarray(outs["rg"].value)
+    m = (np.arange(5)[None, :, None] < np.asarray(lens)[:, None, None])
+    np.testing.assert_allclose(a * m, b * m, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_step_grad_and_state_output():
+    dcs = [data_conf("x4", 16), data_conf("h", 4), data_conf("c", 4)]
+    lc = LayerConf(
+        name="ls", type="lstm_step", size=4,
+        inputs=[InputConf("x4"), InputConf("h"), InputConf("c")],
+    )
+    check_layer_grad(lc, dcs, feed_for(dcs))
+    with dsl.model() as g:
+        x4 = dsl.data("x4", 16)
+        h = dsl.data("h", 4)
+        c = dsl.data("c", 4)
+        dsl._add("lstm_step", [x4, h, c], name="ls", size=4)
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    feed = feed_for(
+        [data_conf("x4", 16), data_conf("h", 4), data_conf("c", 4)]
+    )
+    outs, _ = net.forward(params, feed, outputs=["ls"])
+    assert outs["ls"].value.shape == (4, 4)
+    assert outs["ls@state"].value.shape == (4, 4)  # cell state extra
+
+
+def test_cos_vm():
+    dcs = [data_conf("v", 3), data_conf("m", 12)]
+    lc = LayerConf(name="cv", type="cos_vm", size=4,
+                   inputs=[InputConf("v"), InputConf("m")], bias=False)
+    check_layer_grad(lc, dcs, feed_for(dcs))
+    with dsl.model() as g:
+        v = dsl.data("v", 2)
+        m = dsl.data("m", 4)
+        dsl._add("cos_vm", [v, m], name="out", size=2, bias=False)
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    vv = jnp.asarray([[1.0, 0.0]])
+    mm = jnp.asarray([[1.0, 0.0, 0.0, 1.0]])  # rows: [1,0], [0,1]
+    outs, _ = net.forward(
+        params, {"v": non_seq(vv), "m": non_seq(mm)}, outputs=["out"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs["out"].value), [[1.0, 0.0]], atol=1e-6
+    )
+
+
+def test_data_norm_zscore():
+    with dsl.model() as g:
+        x = dsl.data("x", 3)
+        dsl._add("data_norm", [x], name="out", bias=False,
+                 data_norm_strategy="z-score")
+    net = Network(g.conf)
+    params = dict(net.init_params(jax.random.key(0)))
+    assert net.param_confs["_out.w0"].is_static
+    params["_out.w0"] = jnp.asarray(
+        [[1.0, 2.0, 3.0], [2.0, 4.0, 1.0], [0, 0, 0]]
+    )
+    outs, _ = net.forward(
+        params, {"x": non_seq(jnp.asarray([[3.0, 2.0, 4.0]]))},
+        outputs=["out"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs["out"].value), [[1.0, 0.0, 1.0]], atol=1e-6
+    )
+
+
+def test_selfnorm_ce():
+    with dsl.model() as g:
+        p = dsl.data("p", 4)
+        y = dsl.data("y", 1, is_ids=True)
+        dsl._add("multi_class_cross_entropy_with_selfnorm", [p, y],
+                 name="cost", bias=False, softmax_selfnorm_alpha=0.5)
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    probs = jnp.asarray([[0.5, 0.25, 0.125, 0.125]])  # Z = 1
+    feed = {"p": non_seq(probs), "y": id_arg(jnp.asarray([0], jnp.int32))}
+    loss, _ = net.loss_fn(params, feed)
+    np.testing.assert_allclose(float(loss), -np.log(0.5), rtol=1e-5)
+    # Z != 1 adds alpha * log(Z)^2
+    feed2 = {"p": non_seq(probs * 2), "y": id_arg(jnp.asarray([0], jnp.int32))}
+    loss2, _ = net.loss_fn(params, feed2)
+    want = -np.log(0.5) + 0.5 * np.log(2.0) ** 2
+    np.testing.assert_allclose(float(loss2), want, rtol=1e-5)
+
+
+def test_print_layer_passthrough(capfd):
+    with dsl.model() as g:
+        x = dsl.data("x", 2)
+        dsl._add("print", [x], name="dbg", bias=False)
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    outs, _ = net.forward(
+        params, {"x": non_seq(jnp.asarray([[1.0, 2.0]]))}, outputs=["dbg"]
+    )
+    np.testing.assert_allclose(np.asarray(outs["dbg"].value), [[1, 2]])
+
+
+def test_reference_name_aliases():
+    for name in ("average", "max", "maxid", "out_prod", "huber",
+                 "cudnn_convt", "concat2", "gru_step_naive"):
+        assert LAYERS.get(name) is not None
+    # "average"/"max" layer types imply their pool kind
+    with dsl.model() as g:
+        x = dsl.data("x", 2, is_seq=True)
+        dsl._add("average", [x], name="a", bias=False)
+        dsl._add("max", [x], name="m", bias=False)
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    from paddle_tpu.core.arg import seq
+
+    xv = jnp.asarray([[[1.0, 0.0], [3.0, 2.0], [9.0, 9.0]]])
+    feed = {"x": seq(xv, jnp.asarray([2], jnp.int32))}
+    outs, _ = net.forward(params, feed, outputs=["a", "m"])
+    np.testing.assert_allclose(np.asarray(outs["a"].value), [[2.0, 1.0]])
+    np.testing.assert_allclose(np.asarray(outs["m"].value), [[3.0, 2.0]])
+
+
+def test_cos_vm_zero_vector_grads_finite():
+    with dsl.model() as g:
+        v = dsl.data("v", 2)
+        m = dsl.data("m", 4)
+        out = dsl._add("cos_vm", [v, m], name="out", size=2, bias=False)
+        dsl.sum_cost(out, name="cost")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+
+    def loss(vv):
+        feed = {"v": Arg(value=vv),
+                "m": non_seq(jnp.zeros((1, 4)))}  # NTM zero memory
+        return net.loss_fn(params, feed)[0]
+
+    gr = jax.grad(loss)(jnp.zeros((1, 2)))
+    assert np.isfinite(np.asarray(gr)).all()
+
+
+def test_data_norm_unloaded_stats_identity():
+    with dsl.model() as g:
+        x = dsl.data("x", 3)
+        dsl._add("data_norm", [x], name="out", bias=False)
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))  # stats all zero
+    xv = jnp.asarray([[3.0, -2.0, 4.0]])
+    outs, _ = net.forward(params, {"x": non_seq(xv)}, outputs=["out"])
+    np.testing.assert_allclose(np.asarray(outs["out"].value),
+                               np.asarray(xv))
