@@ -87,6 +87,8 @@ __all__ = [
     "repeat_layer",
     "kmax_sequence_score_layer",
     "simple_attention",
+    "sub_nested_seq_layer",
+    "get_output_layer",
     "memory",
     "recurrent_group",
     # activations (attrs-style classes)
@@ -555,6 +557,14 @@ def simple_attention(encoded_sequence, encoded_proj, decoder_state,
         transform_param=transform_param_attr,
         softmax_param=softmax_param_attr, size=size,
     )
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None, **_):
+    return dsl.sub_nested_seq(_one(input), selected_indices, name=name)
+
+
+def get_output_layer(input, arg_name, name=None, **_):
+    return dsl.get_output(_one(input), arg_name, name=name)
 
 
 # ---- recurrence ----

@@ -652,3 +652,21 @@ def repeat(x, num_repeats, name=None):
 def kmax_seq_score(scores, beam_size=1, name=None):
     return _add("kmax_seq_score", [scores], name=name, bias=False,
                 beam_size=beam_size)
+
+
+def sub_nested_seq(x, selected_indices, name=None):
+    """(layers.py:6098 sub_nested_seq_layer)."""
+    return _add("sub_nested_seq", [x, selected_indices], name=name,
+                bias=False)
+
+
+def get_output(layer, arg_name, name=None):
+    """Reference get_output_layer: reference a layer's named extra
+    output (e.g. lstm_step's cell state). Extra outputs are addressable
+    directly as '<layer>@<arg>' input names; with `name` given, an
+    identity layer is materialized under that name so by-name lookups
+    (outputs, evaluators, boot links) resolve."""
+    ref = LayerRef(f"{layer.name}@{arg_name}", current())
+    if name:
+        return _add("addto", [ref], name=name, bias=False)
+    return ref

@@ -264,3 +264,61 @@ class SubSequenceLayer(Layer):
         mask = (jnp.arange(T)[None, :] < n[:, None]).astype(v.dtype)
         y = y * mask.reshape(mask.shape + (1,) * (v.ndim - 2))
         return Arg(value=y, seq_lens=n.astype(jnp.int32))
+
+
+@LAYERS.register("sub_nested_seq")
+class SubNestedSequenceLayer(Layer):
+    """Select sub-sequences of a nested sequence by per-example indices
+    (SubNestedSequenceLayer.cpp; layers.py:6098 sub_nested_seq_layer —
+    beam training). inputs: [nested (flat [B,T,D] + subseq_lens [B,S]),
+    selected (ids [B,K])]. Output: nested sequence of the K selected
+    sub-sequences, in selection order, compacted to the front."""
+
+    def build(self, in_specs):
+        s, sel = in_specs
+        assert s.has_subseq, "sub_nested_seq needs a nested input"
+        return Spec(dim=s.dim, is_seq=True, has_subseq=True), {}
+
+    def forward(self, params, inputs, ctx):
+        x, sel = inputs
+        v = x.value  # [B, T, D]
+        T = v.shape[1]
+        sl = x.subseq_lens  # [B, S]
+        ends = jnp.cumsum(sl, axis=1)
+        starts = ends - sl
+        k_idx = sel.ids  # [B, K]
+        K = k_idx.shape[1]
+        # invalid selections select NOTHING: -1 sentinels (e.g. from
+        # kmax_seq_score on short sequences) and slots beyond the
+        # selection's own seq_lens must not wrap to the last sub-seq
+        valid_sel = k_idx >= 0
+        if sel.seq_lens is not None:
+            valid_sel = valid_sel & (
+                jnp.arange(K)[None, :] < sel.seq_lens[:, None]
+            )
+        safe_idx = jnp.clip(k_idx, 0, sl.shape[1] - 1)
+        sel_lens = jnp.take_along_axis(sl, safe_idx, axis=1) * valid_sel
+        in_starts = jnp.take_along_axis(starts, safe_idx, axis=1)
+        out_ends = jnp.cumsum(sel_lens, axis=1)  # [B, K]
+        out_starts = out_ends - sel_lens
+        pos = jnp.arange(T, dtype=jnp.int32)[None, :]  # [1, T]
+        # which selected segment does output position p fall into
+        seg = jnp.sum(
+            (pos[:, :, None] >= out_ends[:, None, :]), axis=-1
+        )  # [B, T]
+        seg_c = jnp.minimum(seg, k_idx.shape[1] - 1)
+        offset = pos - jnp.take_along_axis(out_starts, seg_c, axis=1)
+        src = jnp.take_along_axis(in_starts, seg_c, axis=1) + offset
+        valid = pos < out_ends[:, -1:]
+        src = jnp.clip(src, 0, T - 1)
+        y = jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)), axis=1
+        )
+        y = y * valid.reshape(valid.shape + (1,) * (v.ndim - 2)).astype(
+            y.dtype
+        )
+        return Arg(
+            value=y,
+            seq_lens=jnp.sum(sel_lens, axis=1).astype(jnp.int32),
+            subseq_lens=sel_lens.astype(jnp.int32),
+        )

@@ -205,3 +205,89 @@ def test_data_norm_unloaded_stats_identity():
     outs, _ = net.forward(params, {"x": non_seq(xv)}, outputs=["out"])
     np.testing.assert_allclose(np.asarray(outs["out"].value),
                                np.asarray(xv))
+
+
+def test_sub_nested_seq_selection():
+    from paddle_tpu.core.arg import Arg
+
+    with dsl.model() as g:
+        x = dsl.data("x", 1, is_seq=True, has_subseq=True)
+        sel = dsl.data("sel", 1, is_ids=True, is_seq=True)
+        dsl.sub_nested_seq(x, sel, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    # one example: subseqs [10,20],[30],[40,50,60]
+    v = jnp.asarray([[[10.0], [20], [30], [40], [50], [60]]])
+    subl = jnp.asarray([[2, 1, 3]], jnp.int32)
+    feed = {
+        "x": Arg(value=v, seq_lens=jnp.asarray([6], jnp.int32),
+                 subseq_lens=subl),
+        "sel": Arg(ids=jnp.asarray([[2, 0]], jnp.int32),
+                   seq_lens=jnp.asarray([2], jnp.int32)),
+    }
+    outs, _ = net.forward(params, feed, outputs=["out"])
+    got = outs["out"]
+    np.testing.assert_allclose(
+        np.asarray(got.value)[0, :5, 0], [40, 50, 60, 10, 20]
+    )
+    assert np.asarray(got.seq_lens).tolist() == [5]
+    assert np.asarray(got.subseq_lens).tolist() == [[3, 2]]
+
+
+def test_get_output_references_extra():
+    with dsl.model() as g:
+        x4 = dsl.data("x4", 16)
+        h = dsl.data("h", 4)
+        c = dsl.data("c", 4)
+        ls = dsl._add("lstm_step", [x4, h, c], name="ls", size=4)
+        state = dsl.get_output(ls, "state")
+        dsl.fc(state, size=2, name="from_state")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    feed = feed_for(
+        [data_conf("x4", 16), data_conf("h", 4), data_conf("c", 4)]
+    )
+    outs, _ = net.forward(params, feed, outputs=["from_state"])
+    assert outs["from_state"].value.shape == (4, 2)
+
+
+def test_sub_nested_seq_invalid_selection_ignored():
+    from paddle_tpu.core.arg import Arg
+
+    with dsl.model() as g:
+        x = dsl.data("x", 1, is_seq=True, has_subseq=True)
+        sel = dsl.data("sel", 1, is_ids=True, is_seq=True)
+        dsl.sub_nested_seq(x, sel, name="out")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    v = jnp.asarray([[[10.0], [20], [30], [40], [50], [60]]])
+    subl = jnp.asarray([[2, 1, 3]], jnp.int32)
+    feed = {
+        "x": Arg(value=v, seq_lens=jnp.asarray([6], jnp.int32),
+                 subseq_lens=subl),
+        # -1 sentinel + slot beyond seq_lens must both select nothing
+        "sel": Arg(ids=jnp.asarray([[1, -1, 0]], jnp.int32),
+                   seq_lens=jnp.asarray([2], jnp.int32)),
+    }
+    outs, _ = net.forward(params, feed, outputs=["out"])
+    got = outs["out"]
+    assert np.asarray(got.seq_lens).tolist() == [1]  # only subseq 1
+    np.testing.assert_allclose(np.asarray(got.value)[0, 0, 0], 30.0)
+    assert np.asarray(got.subseq_lens).tolist() == [[1, 0, 0]]
+
+
+def test_get_output_named_layer():
+    with dsl.model() as g:
+        x4 = dsl.data("x4", 16)
+        h = dsl.data("h", 4)
+        c = dsl.data("c", 4)
+        ls = dsl._add("lstm_step", [x4, h, c], name="ls", size=4)
+        dsl.get_output(ls, "state", name="cell")
+        g.conf.output_layer_names.append("cell")
+    net = Network(g.conf)
+    params = net.init_params(jax.random.key(0))
+    feed = feed_for(
+        [data_conf("x4", 16), data_conf("h", 4), data_conf("c", 4)]
+    )
+    outs, _ = net.forward(params, feed, outputs=["cell"])
+    assert outs["cell"].value.shape == (4, 4)
