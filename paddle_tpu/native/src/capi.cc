@@ -155,14 +155,40 @@ int pt_capi_forward(int64_t handle, const char** names, const void** bufs,
            *py_addrs = PyList_New(n_inputs),
            *py_shapes = PyList_New(n_inputs),
            *py_ids = PyList_New(n_inputs);
-  for (int i = 0; i < n_inputs; ++i) {
-    PyList_SetItem(py_names, i, PyUnicode_FromString(names[i]));
-    PyList_SetItem(py_addrs, i, PyLong_FromVoidPtr((void*)bufs[i]));
+  bool alloc_ok = py_names && py_addrs && py_shapes && py_ids;
+  for (int i = 0; alloc_ok && i < n_inputs; ++i) {
+    PyObject* nm = PyUnicode_FromString(names[i]);
+    PyObject* addr = PyLong_FromVoidPtr((void*)bufs[i]);
     PyObject* shp = PyList_New(ndims[i]);
-    for (int d = 0; d < ndims[i]; ++d)
-      PyList_SetItem(shp, d, PyLong_FromLongLong(shapes[i][d]));
+    PyObject* ids = PyBool_FromLong(is_ids[i]);
+    if (!nm || !addr || !shp || !ids) {
+      Py_XDECREF(nm);
+      Py_XDECREF(addr);
+      Py_XDECREF(shp);
+      Py_XDECREF(ids);
+      alloc_ok = false;
+      break;
+    }
+    for (int d = 0; alloc_ok && d < ndims[i]; ++d) {
+      PyObject* dim = PyLong_FromLongLong(shapes[i][d]);
+      if (!dim) {
+        alloc_ok = false;
+        break;
+      }
+      PyList_SetItem(shp, d, dim);
+    }
+    PyList_SetItem(py_names, i, nm);
+    PyList_SetItem(py_addrs, i, addr);
     PyList_SetItem(py_shapes, i, shp);
-    PyList_SetItem(py_ids, i, PyBool_FromLong(is_ids[i]));
+    PyList_SetItem(py_ids, i, ids);
+  }
+  if (!alloc_ok) {
+    Py_XDECREF(py_names);
+    Py_XDECREF(py_addrs);
+    Py_XDECREF(py_shapes);
+    Py_XDECREF(py_ids);
+    set_error("forward: allocation failed");
+    return -1;
   }
   PyObject* r = PyObject_CallMethod(
       bridge(), "forward", "LOOOOLL", (long long)handle, py_names, py_addrs,

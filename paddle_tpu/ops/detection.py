@@ -31,9 +31,20 @@ def prior_boxes(
     flip: bool = True,
     clip: bool = True,
 ) -> np.ndarray:
-    """[P, 8] rows of (box4, variance4) — PriorBox.cpp:79-152 ordering:
-    per location, per min_size: min prior, then sqrt(min*max) prior, then
-    one prior per non-1 aspect ratio (input ratios + flipped)."""
+    """[P, 8] rows of (box4, variance4) — PriorBox.cpp:95-145 write-stream
+    ordering: per location, for each min_size: the min prior, then (if any
+    max_sizes) one sqrt(min*max) prior per max_size nested inside that
+    min_size iteration; after the min_size loop, aspect-ratio priors are
+    emitted ONCE per location sized by the LAST min_size (the reference's
+    `minSize` retains its final loop value at PriorBox.cpp:132-136).
+
+    Note: for multi min/max-size configs the reference itself is broken —
+    its declared output dim uses numPriors_ = len(ars) + (1 if max_sizes)
+    (PriorBox.cpp:74-75), which undercounts what its own loop writes, so
+    it overruns its buffer and truncates the copy. We return ALL priors
+    the loop emits (internally consistent: downstream heads here size P
+    from this array); single min/max configs match the reference
+    bit-for-bit."""
     lh, lw = layer_hw
     ih, iw = image_hw
     step_w, step_h = iw / lw, ih / lh
@@ -42,21 +53,26 @@ def prior_boxes(
         ars.append(ar)
         if flip:
             ars.append(1.0 / ar)
+    if max_sizes:
+        assert len(min_sizes) == len(max_sizes), (
+            "PriorBox.cpp:117 requires len(min_sizes)==len(max_sizes)"
+        )
     rows = []
     for h in range(lh):
         for w in range(lw):
             cx, cy = (w + 0.5) * step_w, (h + 0.5) * step_h
-            for s, mn in enumerate(min_sizes):
+            for mn in min_sizes:
                 rows.append((cx, cy, mn, mn))
-                if max_sizes:
-                    m = math.sqrt(mn * max_sizes[s])
+                for mx in max_sizes or ():
+                    m = math.sqrt(mn * mx)
                     rows.append((cx, cy, m, m))
-                for ar in ars:
-                    if abs(ar - 1.0) < 1e-6:
-                        continue
-                    rows.append(
-                        (cx, cy, mn * math.sqrt(ar), mn / math.sqrt(ar))
-                    )
+            mn = min_sizes[-1]
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                rows.append(
+                    (cx, cy, mn * math.sqrt(ar), mn / math.sqrt(ar))
+                )
     r = np.asarray(rows, np.float32)
     boxes = np.stack(
         [

@@ -88,8 +88,9 @@ def moe_ffn(
     Returns (y [N, D], aux_loss). token_mask [N] excludes padding from
     routing entirely.
 
-    Tokens route within groups of (at most) `group_size` — the largest
-    divisor of N is used — so dispatch/combine are [G, S, E, C] with
+    Tokens route within groups of S = min(group_size, N); N is padded up
+    to a multiple of S with masked tokens, so dispatch/combine are
+    [G, S, E, C] with
     C = cf*S/E: memory and FLOPs stay O(N * group_size), GShard's
     grouped layout, instead of O(N^2) for one global group.
 

@@ -121,14 +121,118 @@ def load_merged(path: str):
     return conf, tree.get("params", {}), tree.get("state", {})
 
 
+# --- v2 tar checkpoint format, wire-compatible with the reference ---
+#
+# parameters.py:280-302 serialize/deserialize: each parameter tar member is
+# a 16-byte struct.pack("IIQ", 0, 4, size) header followed by raw
+# little-endian float32 bytes; parameters.py:304-321 to_tar adds a
+# "<name>.protobuf" member holding the serialized ParameterConfig proto
+# (ParameterConfig.proto: name=1 string, size=2 uint64, dims=9 repeated
+# uint64). We hand-encode that wire format (proto2, unpacked varints) so
+# tars round-trip with the reference without a protobuf dependency.
+
+_TAR_HEADER = "<IIQ"  # version=0, elem_size=4, num_elems
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _encode_param_config(name: str, shape, conf=None) -> bytes:
+    """ParameterConfig wire message. `conf` (a core.config.ParameterConf)
+    contributes the optional scalar fields the reference persists:
+    learning_rate=3, momentum=4, initial_mean=5, initial_std=6,
+    decay_rate=7, decay_rate_l1=8 (doubles), is_static=18,
+    sparse_update=22 (bools)."""
+    import struct
+
+    size = 1
+    for d in shape:
+        size *= int(d)
+    out = bytearray()
+    nb = name.encode()
+    out += b"\x0a" + _varint(len(nb)) + nb  # field 1, string
+    out += b"\x10" + _varint(size)  # field 2, uint64
+
+    def put_double(field, v):
+        out.extend(_varint(field << 3 | 1) + struct.pack("<d", float(v)))
+
+    if conf is not None:
+        for field, attr in (
+            (3, "learning_rate"),
+            (4, "momentum"),
+            (5, "initial_mean"),
+            (6, "initial_std"),
+            (7, "decay_rate"),
+            (8, "decay_rate_l1"),
+        ):
+            v = getattr(conf, attr, None)
+            if v is not None:
+                put_double(field, v)
+    for d in shape:  # field 9, repeated uint64 (unpacked)
+        out += b"\x48" + _varint(int(d))
+    if conf is not None:
+        if getattr(conf, "is_static", False):
+            out += _varint(18 << 3) + b"\x01"
+        if getattr(conf, "sparse_update", False):
+            out += _varint(22 << 3) + b"\x01"
+    return bytes(out)
+
+
+def _decode_param_config(data: bytes):
+    """Return (name, dims) from a ParameterConfig wire message, skipping
+    unknown fields (learning_rate etc. are irrelevant for loading)."""
+    name, dims = None, []
+    i, n = 0, len(data)
+
+    def read_varint(i):
+        v = s = 0
+        while True:
+            b = data[i]
+            i += 1
+            v |= (b & 0x7F) << s
+            if not b & 0x80:
+                return v, i
+            s += 7
+
+    while i < n:
+        tag, i = read_varint(i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            v, i = read_varint(i)
+            if field == 9:
+                dims.append(v)
+        elif wire == 1:
+            i += 8
+        elif wire == 2:
+            ln, i = read_varint(i)
+            if field == 1:
+                name = data[i : i + ln].decode()
+            i += ln
+        elif wire == 5:
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+    return name, dims
+
+
 def to_tar(f, params: dict, param_confs: dict = None):
-    """Write parameters as a tar archive — the v2 checkpoint format
-    (python/paddle/v2/parameters.py:304 to_tar): one member per
-    parameter holding raw little-endian float32 bytes, plus a
-    `<name>.conf` JSON sidecar with its config (the reference stores
-    the ParameterConfig proto the same way). `f` is a writable binary
+    """Write parameters as a reference-compatible v2 checkpoint tar
+    (python/paddle/v2/parameters.py:304 to_tar): one member per parameter
+    holding a 16-byte (version=0, elem_size=4, num_elems) header + raw
+    little-endian float32 bytes, plus a `<name>.protobuf` member with the
+    serialized ParameterConfig (name/size/dims). `f` is a writable binary
     file object or a path."""
     import io
+    import struct
     import tarfile
 
     own = isinstance(f, (str, os.PathLike))
@@ -142,44 +246,53 @@ def to_tar(f, params: dict, param_confs: dict = None):
                 tar.addfile(info, io.BytesIO(data))
 
             for name in sorted(params):
-                arr = np.ascontiguousarray(
-                    np.asarray(params[name]), np.float32
+                # NOT ascontiguousarray: it promotes 0-d arrays to 1-d,
+                # losing the () shape; tobytes() copies to C order anyway
+                arr = np.asarray(params[name], dtype=np.float32)
+                header = struct.pack(_TAR_HEADER, 0, 4, arr.size)
+                add(name, header + arr.tobytes())
+                conf = (param_confs or {}).get(name)
+                add(
+                    name + ".protobuf",
+                    _encode_param_config(name, arr.shape, conf),
                 )
-                add(name, arr.tobytes())
-                conf = {"shape": list(arr.shape)}
-                if param_confs and name in param_confs:
-                    pc = param_confs[name]
-                    conf["config"] = (
-                        pc.to_dict() if hasattr(pc, "to_dict") else {}
-                    )
-                add(name + ".conf", json.dumps(conf).encode())
     finally:
         if own:
             fh.close()
 
 
 def from_tar(f) -> dict:
-    """Read a to_tar archive back into {name: np.ndarray}
-    (parameters.py:323 from_tar)."""
+    """Read a v2 checkpoint tar back into {name: np.ndarray}
+    (parameters.py:323 from_tar). Accepts tars written by `to_tar` or by
+    the reference itself: skips the 16-byte member header and reshapes by
+    the dims recorded in the `<name>.protobuf` sidecar."""
     import tarfile
 
     own = isinstance(f, (str, os.PathLike))
-    params: dict = {}
+    raw: dict = {}
     shapes: dict = {}
     tar = tarfile.open(f) if own else tarfile.open(fileobj=f)
     with tar:
         for member in tar.getmembers():
-            data = tar.extractfile(member).read()
+            if not member.isfile():
+                continue
             if member.name.endswith(".conf"):
-                shapes[member.name[: -len(".conf")]] = json.loads(
-                    data.decode()
-                )["shape"]
+                raise ValueError(
+                    "legacy paddle_tpu tar (pre-reference-format, "
+                    "'.conf' JSON sidecars); re-save with to_tar"
+                )
+            data = tar.extractfile(member).read()
+            if member.name.endswith(".protobuf"):
+                pname, dims = _decode_param_config(data)
+                if pname is None:
+                    pname = member.name[: -len(".protobuf")]
+                shapes[pname] = dims
             else:
                 # copy: frombuffer over tar bytes is read-only
-                params[member.name] = np.frombuffer(
-                    data, np.float32
+                raw[member.name] = np.frombuffer(
+                    data[16:], np.float32
                 ).copy()
     return {
         k: v.reshape(shapes[k]) if k in shapes else v
-        for k, v in params.items()
+        for k, v in raw.items()
     }

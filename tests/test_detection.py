@@ -37,6 +37,36 @@ class TestPriorBoxes:
             pb[0, :4], [0.0, 0.0, 1 / 3, 1 / 3], atol=1e-6
         )
 
+    def test_multi_size_ordering(self):
+        """PriorBox.cpp:95-145 with 2 min_sizes × 2 max_sizes: per
+        location [min0, √(min0·max0), √(min0·max1), min1, √(min1·max0),
+        √(min1·max1)] then aspect-ratio priors ONCE sized by the LAST
+        min_size."""
+        pb = D.prior_boxes(
+            layer_hw=(1, 1),
+            image_hw=(100, 100),
+            min_sizes=[10.0, 20.0],
+            max_sizes=[40.0, 90.0],
+            aspect_ratios=[2.0],
+            variances=[0.1, 0.1, 0.2, 0.2],
+            clip=False,
+        )
+        # 2 min × (1 + 2 max) + 2 ratio priors (2.0, 0.5) = 8
+        assert pb.shape == (8, 8)
+        widths = pb[:, 2] - pb[:, 0]
+        heights = pb[:, 3] - pb[:, 1]
+        sq = np.sqrt
+        want_w = np.array(
+            [10, sq(10 * 40), sq(10 * 90), 20, sq(20 * 40), sq(20 * 90),
+             20 * sq(2.0), 20 / sq(2.0)]
+        ) / 100.0
+        want_h = np.array(
+            [10, sq(10 * 40), sq(10 * 90), 20, sq(20 * 40), sq(20 * 90),
+             20 / sq(2.0), 20 * sq(2.0)]
+        ) / 100.0
+        np.testing.assert_allclose(widths, want_w, atol=1e-6)
+        np.testing.assert_allclose(heights, want_h, atol=1e-6)
+
     def test_iou(self):
         a = jnp.asarray([[0.0, 0.0, 0.5, 0.5]])
         b = jnp.asarray([[0.0, 0.0, 0.5, 0.5], [0.25, 0.25, 0.75, 0.75],

@@ -276,3 +276,66 @@ class TestTarFormat:
         buf.seek(0)
         back = ckpt.from_tar(buf)
         assert sorted(back) == sorted(params)
+
+    def test_reference_format_interop(self, tmp_path):
+        """from_tar reads a tar written the way the reference writes it
+        (parameters.py:280-321): 16-byte IIQ header + float32 bytes per
+        member, '<name>.protobuf' ParameterConfig sidecar — built here
+        with an independent encoder; and to_tar's output decodes with an
+        independent reference-style reader."""
+        import io
+        import struct
+        import tarfile
+
+        rng = np.random.default_rng(7)
+        want = {
+            "w": rng.standard_normal((3, 5)).astype(np.float32),
+            "b": rng.standard_normal((5,)).astype(np.float32),
+        }
+
+        def ref_varint(n):
+            out = b""
+            while True:
+                b7, n = n & 0x7F, n >> 7
+                out += bytes([b7 | (0x80 if n else 0)])
+                if not n:
+                    return out
+
+        # --- reference-style writer -> our from_tar ---
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w") as tar:
+            for name, arr in want.items():
+                body = struct.pack("IIQ", 0, 4, arr.size) + arr.tobytes()
+                ti = tarfile.TarInfo(name)
+                ti.size = len(body)
+                tar.addfile(ti, io.BytesIO(body))
+                nb = name.encode()
+                pb = b"\x0a" + ref_varint(len(nb)) + nb
+                pb += b"\x10" + ref_varint(arr.size)
+                # optional field the decoder must skip: learning_rate=1.0
+                pb += b"\x19" + struct.pack("<d", 1.0)
+                for d in arr.shape:
+                    pb += b"\x48" + ref_varint(d)
+                ti = tarfile.TarInfo(name + ".protobuf")
+                ti.size = len(pb)
+                tar.addfile(ti, io.BytesIO(pb))
+        buf.seek(0)
+        back = ckpt.from_tar(buf)
+        assert sorted(back) == sorted(want)
+        for k in want:
+            assert back[k].shape == want[k].shape
+            np.testing.assert_array_equal(back[k], want[k])
+
+        # --- our to_tar -> reference-style reader ---
+        buf = io.BytesIO()
+        ckpt.to_tar(buf, want)
+        buf.seek(0)
+        with tarfile.open(fileobj=buf) as tar:
+            names = tar.getnames()
+            for name, arr in want.items():
+                assert name in names and name + ".protobuf" in names
+                body = tar.extractfile(name).read()
+                ver, esz, cnt = struct.unpack("IIQ", body[:16])
+                assert (ver, esz, cnt) == (0, 4, arr.size)
+                got = np.frombuffer(body[16:], np.float32)
+                np.testing.assert_array_equal(got, arr.ravel())
