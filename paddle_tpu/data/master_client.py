@@ -1,0 +1,192 @@
+"""Client for the networked elastic master (native/src/master_server.cc).
+
+The counterpart of the reference's Go master client
+(go/master/client.go, consumed from Python via ctypes in
+python/paddle/v2/master/client.py): trainer processes connect over TCP,
+lease chunk tasks, and report done/failed. `MasterClient` duck-types
+`paddle_tpu.native.master.Master`, so `paddle_tpu.data.reader.elastic`
+works with either — in-process for single-host, networked for
+multi-host fault tolerance.
+
+Resilience: every call reconnects and retries with backoff for up to
+`retry_seconds` (the master may be restarting from its snapshot —
+go/master/service.go:166-207 recovery). Lease state lives on the
+server, so a client reconnect does not lose or duplicate tasks.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import time
+from typing import Optional
+
+_OP_ADD_TASK = 1
+_OP_GET_TASK = 2
+_OP_TASK_DONE = 3
+_OP_TASK_FAILED = 4
+_OP_PASS_FINISHED = 5
+_OP_START_PASS = 6
+_OP_COUNT = 7
+_OP_SET_LEASE = 8
+_OP_SNAPSHOT = 9
+_OP_REQUEST_SAVE = 10
+_OP_PING = 11
+_OP_SHUTDOWN = 12
+
+
+class MasterClient:
+    def __init__(
+        self,
+        addr: str,
+        retry_seconds: float = 30.0,
+        connect_timeout: float = 5.0,
+    ):
+        """`addr` is "host:port"."""
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        self._retry = retry_seconds
+        self._timeout = connect_timeout
+        self._sock: Optional[socket.socket] = None
+
+    # ---- wire ----
+    def _connect(self):
+        s = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        s.settimeout(None)  # calls block until the master answers
+        self._sock = s
+
+    def _recv_full(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("master closed connection")
+            out += chunk
+        return out
+
+    def _call_once(self, op: int, body: bytes) -> tuple:
+        if self._sock is None:
+            self._connect()
+        frame = struct.pack("<IB", 1 + len(body), op) + body
+        self._sock.sendall(frame)
+        (rlen,) = struct.unpack("<I", self._recv_full(4))
+        resp = self._recv_full(rlen)
+        (status,) = struct.unpack("<q", resp[:8])
+        return status, resp[8:]
+
+    def _call(self, op: int, body: bytes = b"") -> tuple:
+        deadline = time.monotonic() + self._retry
+        delay = 0.05
+        while True:
+            try:
+                return self._call_once(op, body)
+            except (OSError, ConnectionError):
+                self.close()
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    # ---- Master-compatible API ----
+    def add_task(self, payload) -> int:
+        if isinstance(payload, str):
+            payload = payload.encode()
+        status, _ = self._call(_OP_ADD_TASK, payload)
+        return status
+
+    def add_chunk_tasks(self, path: str, num_chunks: int) -> None:
+        import json
+
+        for i in range(num_chunks):
+            self.add_task(json.dumps({"path": path, "chunk": i}).encode())
+
+    def get_task(self) -> Optional[tuple]:
+        """Lease a task: (task_id, payload) or None if nothing leasable."""
+        status, body = self._call(_OP_GET_TASK)
+        if status == -3:
+            return None
+        if status < 0:
+            raise RuntimeError(f"get_task failed (code {status})")
+        (lease,) = struct.unpack("<q", body[:8])
+        return lease, body[8 : 8 + status]
+
+    def task_done(self, task_id: int) -> bool:
+        status, _ = self._call(_OP_TASK_DONE, struct.pack("<q", task_id))
+        return status == 0
+
+    def task_failed(self, task_id: int) -> bool:
+        status, _ = self._call(_OP_TASK_FAILED, struct.pack("<q", task_id))
+        return status == 0
+
+    def pass_finished(self) -> bool:
+        status, _ = self._call(_OP_PASS_FINISHED)
+        return status == 1
+
+    def start_pass(self) -> int:
+        status, _ = self._call(_OP_START_PASS)
+        return status
+
+    @property
+    def counts(self) -> dict:
+        out = {}
+        for i, k in enumerate(("todo", "pending", "done", "discarded")):
+            status, _ = self._call(_OP_COUNT, struct.pack("<i", i))
+            out[k] = status
+        return out
+
+    def set_lease(self, seconds: float) -> None:
+        self._call(_OP_SET_LEASE, struct.pack("<d", seconds))
+
+    def snapshot(self) -> None:
+        status, _ = self._call(_OP_SNAPSHOT)
+        if status != 0:
+            raise IOError(
+                "snapshot failed"
+                + (" (server has no snapshot path)" if status == -2 else "")
+            )
+
+    def request_save_model(
+        self, trainer_id: str, block_seconds: float = 60.0
+    ) -> bool:
+        """Save-model election (go/master/service.go:467-495)."""
+        status, _ = self._call(
+            _OP_REQUEST_SAVE,
+            struct.pack("<d", block_seconds) + trainer_id.encode(),
+        )
+        if status < 0:
+            raise ValueError("trainer_id must be non-empty")
+        return status == 1
+
+    def ping(self) -> bool:
+        try:
+            return self._call_once(_OP_PING, b"")[0] == 0
+        except (OSError, ConnectionError):
+            self.close()
+            return False
+
+    def shutdown(self) -> None:
+        """Ask the serving process to stop (it snapshots first if
+        configured)."""
+        try:
+            self._call_once(_OP_SHUTDOWN, b"")
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

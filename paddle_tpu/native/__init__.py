@@ -132,6 +132,17 @@ def load() -> ctypes.CDLL:
         lib.pt_master_snapshot.argtypes = [p, cp]
         lib.pt_master_restore.restype = p
         lib.pt_master_restore.argtypes = [cp]
+        lib.pt_master_request_save.restype = i32
+        lib.pt_master_request_save.argtypes = [p, cp, f64]
+
+        # master server (networked elastic master)
+        lib.pt_master_server_start.restype = p
+        lib.pt_master_server_start.argtypes = [p, i32, cp, f64]
+        lib.pt_master_server_port.restype = i32
+        lib.pt_master_server_port.argtypes = [p]
+        lib.pt_master_server_stopped.restype = i32
+        lib.pt_master_server_stopped.argtypes = [p]
+        lib.pt_master_server_stop.argtypes = [p]
 
         _lib = lib
         return _lib

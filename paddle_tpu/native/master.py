@@ -92,6 +92,39 @@ class Master:
     def set_lease(self, seconds: float) -> None:
         self._lib.pt_master_set_lease(self._h, seconds)
 
+    def request_save_model(
+        self, trainer_id: str, block_seconds: float = 60.0
+    ) -> bool:
+        """Save-model election (go/master/service.go:467-495): True iff
+        this trainer should save; the grant blocks other trainers for
+        `block_seconds`."""
+        r = self._lib.pt_master_request_save(
+            self._h, trainer_id.encode(), block_seconds
+        )
+        if r < 0:
+            raise ValueError("trainer_id must be non-empty")
+        return r == 1
+
+    # ---- serving (networked master; see data/master_client.py) ----
+    def serve(
+        self,
+        port: int = 0,
+        snapshot_path: Optional[str] = None,
+        snapshot_every: float = 0.0,
+    ) -> "MasterServer":
+        """Expose this master over TCP (master_server.cc) so trainer
+        processes on other hosts can lease tasks — the Go master's RPC
+        service (go/master/service.go:89). Returns the running server."""
+        h = self._lib.pt_master_server_start(
+            self._h,
+            port,
+            snapshot_path.encode() if snapshot_path else None,
+            snapshot_every,
+        )
+        if not h:
+            raise OSError(f"cannot serve master on port {port}")
+        return MasterServer(self._lib, h, self)
+
     # ---- durability ----
     def snapshot(self, path: str) -> None:
         if self._lib.pt_master_snapshot(self._h, path.encode()) != 0:
@@ -108,4 +141,27 @@ class Master:
         h = getattr(self, "_h", None)
         if h:
             self._lib.pt_master_destroy(h)
+            self._h = None
+
+
+class MasterServer:
+    """Handle for a running networked master (pt_master_server_*)."""
+
+    def __init__(self, lib, handle, master: "Master"):
+        self._lib = lib
+        self._h = handle
+        self.master = master  # keep the Master alive while serving
+
+    @property
+    def port(self) -> int:
+        return self._lib.pt_master_server_port(self._h)
+
+    @property
+    def stopped(self) -> bool:
+        """True once a client sent SHUTDOWN."""
+        return self._lib.pt_master_server_stopped(self._h) == 1
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.pt_master_server_stop(self._h)
             self._h = None

@@ -46,6 +46,11 @@ struct Master {
   std::unordered_map<int64_t, std::pair<Task, double>> pending;  // lease -> (task, deadline)
   std::vector<Task> done;
   std::vector<Task> discarded;
+  // save-model election (go/master/service.go:467-495): the granted
+  // trainer holds the save slot until block_dur elapses; re-requests by
+  // the same trainer are re-granted. Transient — not snapshotted.
+  std::string saving_trainer;
+  double saving_deadline = 0.0;
   std::mutex mu;
 
   void requeue_expired_locked() {
@@ -187,6 +192,24 @@ int64_t pt_master_count(Master* m, int which) {
     case 3: return static_cast<int64_t>(m->discarded.size());
     default: return -1;
   }
+}
+
+// Save-model election (go/master/service.go:467-495 RequestSaveModel):
+// returns 1 if `trainer_id` should save (it becomes the saving trainer
+// for `block_seconds`), 0 if another trainer holds the slot, -1 on empty
+// trainer id.
+int pt_master_request_save(Master* m, const char* trainer_id,
+                           double block_seconds) {
+  if (!trainer_id || !*trainer_id) return -1;
+  std::lock_guard<std::mutex> l(m->mu);
+  double t = now_s();
+  bool need = m->saving_trainer.empty() || m->saving_deadline <= t ||
+              m->saving_trainer == trainer_id;
+  if (need) {
+    m->saving_trainer = trainer_id;
+    m->saving_deadline = t + block_seconds;
+  }
+  return need ? 1 : 0;
 }
 
 void pt_master_set_lease(Master* m, double lease_seconds) {

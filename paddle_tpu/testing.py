@@ -94,6 +94,10 @@ def check_layer_grad(
             v = v * m.reshape(m.shape + (1,) * (v.ndim - 2))
         return jnp.sum(v)
 
+    # jit once: numeric differencing calls this O(params*64*2) times,
+    # and the eager op-by-op walk dominated the suite's wall clock
+    compute_loss = jax.jit(compute_loss)
+
     # analytic
     g_params = jax.grad(compute_loss)(params, feed)
 

@@ -1,0 +1,227 @@
+"""Networked elastic master: cross-process fault tolerance.
+
+Mirrors the reference's Go master service semantics
+(go/master/service.go:89-495): trainers in other processes lease chunk
+tasks over TCP, a killed trainer's lease expires and its chunk is
+re-served, the pass completes with every chunk ack'd exactly once, the
+save-model election grants exactly one trainer, and a killed master
+restarts from its snapshot without losing the pass.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Worker process: lease tasks, append each ack'd payload to OUT_FILE.
+# If HANG_AT is set, hang forever (without acking) upon leasing that
+# payload — the parent then SIGKILLs us, simulating a trainer crash
+# mid-task.
+WORKER_SRC = """
+import json, os, sys, time
+sys.path.insert(0, os.environ["REPO"])
+from paddle_tpu.data.master_client import MasterClient
+
+c = MasterClient(os.environ["ADDR"])
+hang_at = os.environ.get("HANG_AT")
+out = open(os.environ["OUT_FILE"], "a")
+while not c.pass_finished():
+    t = c.get_task()
+    if t is None:
+        time.sleep(0.02)
+        continue
+    task_id, payload = t
+    if hang_at and json.loads(payload)["chunk"] == int(hang_at):
+        time.sleep(3600)  # crash point: parent kills us holding the lease
+    time.sleep(0.01)  # pretend to read the chunk
+    if c.task_done(task_id):
+        out.write(payload.decode() + "\\n")
+        out.flush()
+"""
+
+
+def _start_master(tmp_path, lease="0.6", snapshot=None, extra=()):
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.data.master_serve",
+        "--port", "0", "--lease-seconds", lease, *extra,
+    ]
+    if snapshot:
+        cmd += ["--snapshot", snapshot, "--snapshot-every", "0.2"]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, text=True, cwd=REPO
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.split()[1])
+
+
+def _start_worker(addr, out_file, hang_at=None):
+    env = dict(os.environ, REPO=REPO, ADDR=addr, OUT_FILE=out_file)
+    if hang_at is not None:
+        env["HANG_AT"] = str(hang_at)
+    return subprocess.Popen([sys.executable, "-c", WORKER_SRC], env=env)
+
+
+class TestCrossProcessFaultTolerance:
+    def test_killed_worker_pass_completes_exactly_once(self, tmp_path):
+        """Master + 2 worker processes; one is SIGKILLed mid-task. The
+        pass still completes, and every chunk is ack'd exactly once
+        across the survivors (service.go:313-356 requeue semantics)."""
+        from paddle_tpu.data.master_client import MasterClient
+
+        n_chunks = 12
+        hang_chunk = 5
+        master, port = _start_master(tmp_path, lease="0.6")
+        addr = f"127.0.0.1:{port}"
+        out_a = str(tmp_path / "a.jsonl")
+        out_b = str(tmp_path / "b.jsonl")
+        try:
+            c = MasterClient(addr)
+            for i in range(n_chunks):
+                c.add_task(json.dumps({"chunk": i}).encode())
+
+            wa = _start_worker(addr, out_a, hang_at=hang_chunk)
+            wb = _start_worker(addr, out_b)
+
+            # wait until worker A has leased its hang chunk, then kill it
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                done = []
+                for f in (out_a, out_b):
+                    if os.path.exists(f):
+                        done += [json.loads(l)["chunk"]
+                                 for l in open(f).read().splitlines()]
+                # A hangs on chunk 5 only after leasing it; once every
+                # other chunk is ack'd, A must be holding chunk 5
+                if len(done) == n_chunks - 1 and hang_chunk not in done:
+                    break
+                time.sleep(0.05)
+            wa.kill()
+            wa.wait()
+
+            # lease expires -> chunk requeued -> B finishes the pass
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if c.pass_finished():
+                    break
+                time.sleep(0.05)
+            assert c.pass_finished(), c.counts
+
+            wb.terminate()
+            wb.wait(timeout=10)
+
+            acked = []
+            for f in (out_a, out_b):
+                if os.path.exists(f):
+                    acked += [json.loads(l)["chunk"]
+                              for l in open(f).read().splitlines()]
+            assert sorted(acked) == list(range(n_chunks)), (
+                f"chunks ack'd {sorted(acked)} != exactly once each"
+            )
+            counts = c.counts
+            assert counts["done"] == n_chunks and counts["discarded"] == 0
+        finally:
+            for p in (wa, wb):
+                if p.poll() is None:
+                    p.kill()
+            MasterClient(addr, retry_seconds=1).shutdown()
+            master.wait(timeout=10)
+
+    def test_save_model_election_grants_exactly_one(self, tmp_path):
+        """RequestSaveModel (service.go:467-495): of N concurrent
+        trainers, exactly one is told to save; re-request by the winner
+        is re-granted; after block_dur the slot reopens."""
+        from paddle_tpu.data.master_client import MasterClient
+
+        master, port = _start_master(tmp_path)
+        addr = f"127.0.0.1:{port}"
+        try:
+            clients = [MasterClient(addr) for _ in range(4)]
+            grants = [
+                c.request_save_model(f"trainer-{i}", block_seconds=0.5)
+                for i, c in enumerate(clients)
+            ]
+            assert sum(grants) == 1 and grants[0]
+            # winner re-asks: still granted
+            assert clients[0].request_save_model("trainer-0", 0.5)
+            # block expires: slot reopens for someone else
+            time.sleep(0.6)
+            assert clients[2].request_save_model("trainer-2", 0.5)
+        finally:
+            MasterClient(addr, retry_seconds=1).shutdown()
+            master.wait(timeout=10)
+
+    def test_master_restart_restores_from_snapshot(self, tmp_path):
+        """SIGKILL the master mid-pass; a restart with the same
+        --snapshot resumes: done tasks stay done, leased tasks return to
+        todo (service.go:166-207 recovery semantics)."""
+        from paddle_tpu.data.master_client import MasterClient
+
+        snap = str(tmp_path / "master.snap")
+        master, port = _start_master(tmp_path, lease="60", snapshot=snap)
+        addr = f"127.0.0.1:{port}"
+        try:
+            c = MasterClient(addr)
+            for i in range(6):
+                c.add_task(json.dumps({"chunk": i}).encode())
+            t = c.get_task()
+            c.task_done(t[0])
+            c.get_task()  # leave one leased (pending)
+            c.snapshot()  # deterministic snapshot point
+        finally:
+            master.kill()  # no graceful snapshot — crash
+            master.wait()
+
+        master2, port2 = _start_master(tmp_path, lease="60", snapshot=snap)
+        try:
+            c2 = MasterClient(f"127.0.0.1:{port2}")
+            counts = c2.counts
+            # 1 done survived; the leased task went back to todo
+            assert counts["done"] == 1
+            assert counts["todo"] == 5
+            assert counts["pending"] == 0
+            # pass still completes
+            while (t := c2.get_task()) is not None:
+                c2.task_done(t[0])
+            assert c2.pass_finished()
+        finally:
+            MasterClient(f"127.0.0.1:{port2}", retry_seconds=1).shutdown()
+            master2.wait(timeout=10)
+
+
+class TestElasticReaderOverNetwork:
+    def test_elastic_reader_with_master_client(self, tmp_path):
+        """data.reader.elastic streams records from chunks leased off a
+        NETWORKED master — the full Go-master input path
+        (go/master/client.go NextRecord equivalent)."""
+        import pickle
+
+        from paddle_tpu.data import reader as R
+        from paddle_tpu.data.master_client import MasterClient
+        from paddle_tpu.native.recordio import RecordWriter, count_chunks
+
+        path = str(tmp_path / "data.rec")
+        records = [{"i": i} for i in range(50)]
+        with RecordWriter(path, max_chunk_bytes=256) as w:
+            for r in records:
+                w.write(pickle.dumps(r))
+        n_chunks = count_chunks(path)
+        assert n_chunks >= 3  # small chunks -> several lease units
+
+        master, port = _start_master(tmp_path, lease="30")
+        addr = f"127.0.0.1:{port}"
+        try:
+            c = MasterClient(addr)
+            c.add_chunk_tasks(path, n_chunks)
+            got = [r["i"] for r in R.elastic(MasterClient(addr))()]
+            assert sorted(got) == list(range(50))
+            assert c.pass_finished()
+        finally:
+            MasterClient(addr, retry_seconds=1).shutdown()
+            master.wait(timeout=10)
