@@ -1,0 +1,8 @@
+"""`paddle` import-namespace shim: lets UNMODIFIED reference v1 config
+files and data providers (`from paddle.trainer_config_helpers import *`,
+`from paddle.trainer.PyDataProvider2 import *`) execute against
+paddle_tpu. Exec configs via
+`paddle_tpu.compat.config_parser.parse_config` (or `paddle.trainer.
+config_parser.parse_config`, the reference's own entry point —
+python/paddle/trainer/config_parser.py:3724).
+"""

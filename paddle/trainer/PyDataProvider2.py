@@ -1,0 +1,27 @@
+"""`paddle.trainer.PyDataProvider2` shim: the data-provider declaration
+API reference providers star-import (python/paddle/trainer/
+PyDataProvider2.py:39-329), backed by paddle_tpu.data.
+"""
+
+from paddle_tpu.data.feeder import (  # noqa: F401
+    InputType,
+    dense_vector,
+    dense_vector_sequence,
+    integer_value,
+    integer_value_sequence,
+    integer_value_sub_sequence,
+    sparse_binary_vector,
+    sparse_float_vector,
+)
+from paddle_tpu.data.provider import CacheType, provider  # noqa: F401
+
+# older alias used by some reference providers
+sparse_vector = sparse_float_vector
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, seq_type=1)
+
+
+def sparse_vector_sequence(dim):
+    return sparse_float_vector(dim, seq_type=1)

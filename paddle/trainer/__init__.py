@@ -1,0 +1,1 @@
+"""Shim for the reference's `paddle.trainer` package."""

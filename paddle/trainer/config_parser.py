@@ -1,0 +1,9 @@
+"""`paddle.trainer.config_parser` shim — the reference's parse_config
+entry point (python/paddle/trainer/config_parser.py:3724) backed by
+paddle_tpu.compat.config_parser.
+"""
+
+from paddle_tpu.compat.config_parser import (  # noqa: F401
+    get_config_arg,
+    parse_config,
+)

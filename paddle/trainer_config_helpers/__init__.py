@@ -1,0 +1,8 @@
+"""`paddle.trainer_config_helpers` shim: the complete star-import
+authoring surface of the reference's v1 config helpers
+(python/paddle/trainer_config_helpers/{layers,networks,optimizers,
+attrs,poolings,activations}.py), backed by paddle_tpu.compat.
+"""
+
+from paddle_tpu.compat.config_parser import *  # noqa: F401,F403
+from paddle_tpu.compat.layers_v1 import *  # noqa: F401,F403

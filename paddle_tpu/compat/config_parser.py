@@ -1,0 +1,386 @@
+"""Execute UNMODIFIED reference v1 config files.
+
+The counterpart of python/paddle/trainer/config_parser.py:3724
+`parse_config(config_file, config_arg_str)`: a config file written
+against `paddle.trainer_config_helpers` (the 2017 authoring surface) is
+exec'd as-is — `from paddle.trainer_config_helpers import *` resolves to
+the shim package at the repo root, which re-exports
+`paddle_tpu.compat.layers_v1` plus the settings/optimizer/data-source
+surface defined here — and yields a `TrainerConfig` holding the
+paddle_tpu `ModelConf` + `OptimizationConf` + data-source declarations.
+
+Python-2-era configs are supported: `xrange` is injected into the exec
+namespace, and `load_provider_module` execs provider modules the same
+way so `@provider` generators using xrange run unmodified.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+from paddle_tpu.core.config import ModelConf, OptimizationConf
+
+__all__ = [
+    "get_config_arg",
+    "settings",
+    "define_py_data_sources2",
+    "outputs",
+    "parse_config",
+    "load_provider_module",
+    "TrainerConfig",
+    "apply_data_types",
+    "DataSources",
+    # optimizer settings (trainer_config_helpers/optimizers.py)
+    "MomentumOptimizer",
+    "AdamOptimizer",
+    "AdamaxOptimizer",
+    "AdaGradOptimizer",
+    "DecayedAdaGradOptimizer",
+    "AdaDeltaOptimizer",
+    "RMSPropOptimizer",
+    "L1Regularization",
+    "L2Regularization",
+    # attrs / poolings (trainer_config_helpers/{attrs,poolings}.py)
+    "ExtraAttr",
+    "ExtraLayerAttribute",
+    "MaxPooling",
+    "AvgPooling",
+    "SumPooling",
+    "SqrtAvgPooling",
+]
+
+
+# ---- parse context -------------------------------------------------------
+
+class _ParseCtx:
+    def __init__(self, args: dict):
+        self.args = args
+        self.opt = OptimizationConf()
+        self.data_sources: Optional[DataSources] = None
+        self.outputs: list = []
+
+
+_stack: list = []  # innermost parse context last
+
+
+def _ctx() -> Optional[_ParseCtx]:
+    return _stack[-1] if _stack else None
+
+
+def get_config_arg(name, type_=str, default=None):
+    """--config_args interpolation (config_parser.py get_config_arg):
+    values arrive as strings and are cast with `type_`."""
+    ctx = _ctx()
+    if ctx is None or name not in ctx.args:
+        return default
+    v = ctx.args[name]
+    if type_ is bool:
+        if isinstance(v, str):
+            return v.strip().lower() not in ("", "0", "false", "no")
+        return bool(v)
+    return type_(v)
+
+
+# ---- optimizer / regularization settings objects -------------------------
+
+class _OptSetting:
+    """Maps onto OptimizationConf fields."""
+
+    fields: dict = {}
+
+
+class MomentumOptimizer(_OptSetting):
+    def __init__(self, momentum=0.9, sparse=False):
+        self.fields = {"learning_method": "momentum", "momentum": momentum}
+
+
+class AdamOptimizer(_OptSetting):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.fields = {
+            "learning_method": "adam",
+            "adam_beta1": beta1,
+            "adam_beta2": beta2,
+            "adam_epsilon": epsilon,
+        }
+
+
+class AdamaxOptimizer(_OptSetting):
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.fields = {
+            "learning_method": "adamax",
+            "adam_beta1": beta1,
+            "adam_beta2": beta2,
+        }
+
+
+class AdaGradOptimizer(_OptSetting):
+    def __init__(self, epsilon=1e-6):
+        self.fields = {"learning_method": "adagrad", "ada_epsilon": epsilon}
+
+
+class DecayedAdaGradOptimizer(_OptSetting):
+    def __init__(self, rou=0.95, epsilon=1e-6):
+        self.fields = {
+            "learning_method": "decayed_adagrad",
+            "ada_rou": rou,
+            "ada_epsilon": epsilon,
+        }
+
+
+class AdaDeltaOptimizer(_OptSetting):
+    def __init__(self, rou=0.95, epsilon=1e-6):
+        self.fields = {
+            "learning_method": "adadelta",
+            "ada_rou": rou,
+            "ada_epsilon": epsilon,
+        }
+
+
+class RMSPropOptimizer(_OptSetting):
+    def __init__(self, rou=0.95, epsilon=1e-6):
+        self.fields = {
+            "learning_method": "rmsprop",
+            "ada_rou": rou,
+            "ada_epsilon": epsilon,
+        }
+
+
+class L2Regularization(_OptSetting):
+    def __init__(self, rate):
+        self.fields = {"l2_rate": rate}
+
+
+class L1Regularization(_OptSetting):
+    def __init__(self, rate):
+        self.fields = {"l1_rate": rate}
+
+
+def settings(batch_size=256, learning_rate=0.01, learning_method=None,
+             regularization=None, gradient_clipping_threshold=None,
+             learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
+             learning_rate_schedule=None, learning_rate_args="",
+             average_window=0, max_average_window=0, **_):
+    """trainer_config_helpers `settings(...)` -> OptimizationConf
+    (config_parser.py:3576 Settings)."""
+    ctx = _ctx()
+    assert ctx is not None, "settings() outside parse_config"
+    o = ctx.opt
+    o.batch_size = batch_size
+    o.learning_rate = learning_rate
+    o.learning_rate_decay_a = learning_rate_decay_a
+    o.learning_rate_decay_b = learning_rate_decay_b
+    if learning_rate_schedule:
+        o.learning_rate_schedule = learning_rate_schedule
+    o.learning_rate_args = learning_rate_args
+    o.average_window = average_window
+    o.max_average_window = max_average_window
+    if gradient_clipping_threshold is not None:
+        o.gradient_clipping_threshold = gradient_clipping_threshold
+    for setting in (learning_method, regularization):
+        if setting is not None:
+            for k, v in setting.fields.items():
+                setattr(o, k, v)
+    return o
+
+
+# ---- attrs / poolings ----------------------------------------------------
+
+class ExtraLayerAttribute:
+    """(trainer_config_helpers/attrs.py ExtraLayerAttribute)."""
+
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None, **_):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+
+ExtraAttr = ExtraLayerAttribute
+
+
+class _Pooling:
+    name = ""
+
+
+class MaxPooling(_Pooling):
+    name = "max"
+
+
+class AvgPooling(_Pooling):
+    name = "avg"
+
+
+class SumPooling(_Pooling):
+    name = "sum"
+
+
+class SqrtAvgPooling(_Pooling):
+    name = "sqrt_average"
+
+
+# ---- data sources --------------------------------------------------------
+
+@dataclass
+class DataSources:
+    """define_py_data_sources2 declaration
+    (trainer_config_helpers/data_sources.py)."""
+
+    train_list: Optional[str] = None
+    test_list: Optional[str] = None
+    module: str = ""
+    obj: str = ""
+    args: dict = field(default_factory=dict)
+    search_dir: str = ""  # config file's directory: providers live there
+
+    def _reader(self, file_list, obj=None):
+        import paddle_tpu.data.reader as R
+
+        mod = load_provider_module(self.module, self.search_dir)
+        provider = getattr(mod, obj or self.obj)
+        files = [
+            ln.strip()
+            for ln in open(file_list).read().splitlines()
+            if ln.strip()
+        ]
+        return provider(files, **self.args), provider.input_types
+
+    def train_reader(self):
+        """(reader_creator, input_types) for the train list."""
+        return self._reader(self.train_list)
+
+    def test_reader(self):
+        return self._reader(self.test_list)
+
+
+def define_py_data_sources2(train_list=None, test_list=None, module="",
+                            obj="", args=None, **_):
+    ctx = _ctx()
+    assert ctx is not None, "define_py_data_sources2 outside parse_config"
+    ctx.data_sources = DataSources(
+        train_list=train_list,
+        test_list=test_list,
+        module=module,
+        obj=obj,
+        args=dict(args or {}),
+    )
+    return ctx.data_sources
+
+
+def outputs(*layer_refs):
+    """Mark output/cost layers (trainer_config_helpers `outputs`)."""
+    ctx = _ctx()
+    assert ctx is not None, "outputs() outside parse_config"
+    flat = []
+    for r in layer_refs:
+        flat += list(r) if isinstance(r, (list, tuple)) else [r]
+    ctx.outputs = [getattr(r, "name", r) for r in flat]
+
+
+# ---- the parser ----------------------------------------------------------
+
+@dataclass
+class TrainerConfig:
+    """What parse_config returns: everything the trainer needs."""
+
+    model: ModelConf
+    opt: OptimizationConf
+    data_sources: Optional[DataSources]
+    args: dict
+
+
+def _parse_args(config_args) -> dict:
+    if not config_args:
+        return {}
+    if isinstance(config_args, dict):
+        return dict(config_args)
+    out = {}
+    for pair in str(config_args).split(","):
+        if not pair.strip():
+            continue
+        k, _, v = pair.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+def parse_config(config_file: str, config_args="") -> TrainerConfig:
+    """Exec a v1 config file (config_parser.py:3724 parse_config).
+
+    `config_args` is the CLI `--config_args` string ("a=1,b=2") or a
+    dict; values reach the config via `get_config_arg`. The file's own
+    `from paddle.trainer_config_helpers import *` resolves through the
+    repo-root `paddle` shim package. Relative paths in the config (dict
+    files, data lists) resolve against the CURRENT working directory,
+    exactly as `paddle train` resolved them."""
+    from paddle_tpu import dsl
+
+    ctx = _ParseCtx(_parse_args(config_args))
+    _stack.append(ctx)
+    try:
+        with open(config_file) as f:
+            code = compile(f.read(), config_file, "exec")
+        ns = {
+            "__file__": os.path.abspath(config_file),
+            "__name__": "__paddle_config__",
+            "xrange": range,  # py2-era configs
+        }
+        with dsl.model() as g:
+            exec(code, ns)
+        conf = g.conf
+    finally:
+        _stack.pop()
+    if ctx.outputs:
+        for name in ctx.outputs:
+            if name not in conf.output_layer_names:
+                conf.output_layer_names.append(name)
+    if ctx.data_sources is not None:
+        ctx.data_sources.search_dir = os.path.dirname(
+            os.path.abspath(config_file)
+        )
+    return TrainerConfig(
+        model=conf, opt=ctx.opt, data_sources=ctx.data_sources,
+        args=ctx.args,
+    )
+
+
+def apply_data_types(model: ModelConf, input_types) -> None:
+    """Annotate the model's data layers from a provider's input_types —
+    in v1 the slot type (dense/ids/sparse × seq level) came from the
+    data-provider declaration (PyDataProvider2.py:47-214), not from the
+    config's data_layer calls. `input_types` is a dict name->InputType
+    or a list in data-layer declaration order."""
+    data_layers = [lc for lc in model.layers if lc.type == "data"]
+    if isinstance(input_types, dict):
+        pairs = [
+            (lc, input_types[lc.name])
+            for lc in data_layers
+            if lc.name in input_types
+        ]
+    else:
+        pairs = list(zip(data_layers, input_types))
+    for lc, t in pairs:
+        lc.attrs["is_ids"] = t.kind == "ids"
+        lc.attrs["is_seq"] = t.seq >= 1
+        lc.attrs["has_subseq"] = t.seq == 2
+
+
+def load_provider_module(name_or_path: str, search_dir: str = ""):
+    """Import a data-provider module the way the embedded interpreter
+    did (PyDataProvider2.cpp loads the module by name with the config
+    dir on sys.path) — but exec'd with `xrange` injected so py2-era
+    providers run unmodified."""
+    import types
+
+    path = name_or_path
+    if not path.endswith(".py"):
+        path = os.path.join(search_dir, name_or_path + ".py")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"provider module not found: {path}")
+    mod = types.ModuleType(os.path.basename(path)[:-3])
+    mod.__file__ = path
+    mod.__dict__["xrange"] = range
+    with open(path) as f:
+        code = compile(f.read(), path, "exec")
+    exec(code, mod.__dict__)
+    return mod

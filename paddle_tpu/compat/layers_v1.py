@@ -53,6 +53,13 @@ __all__ = [
     "seq_reshape_layer",
     "sub_seq_layer",
     "mixed_layer",
+    "full_matrix_projection",
+    "trans_full_matrix_projection",
+    "identity_projection",
+    "dotmul_projection",
+    "scaling_projection",
+    "table_projection",
+    "context_projection",
     "tensor_layer",
     "cos_sim",
     "scaling_layer",
@@ -87,6 +94,11 @@ __all__ = [
     "repeat_layer",
     "kmax_sequence_score_layer",
     "simple_attention",
+    "simple_lstm",
+    "simple_gru",
+    "bidirectional_lstm",
+    "simple_img_conv_pool",
+    "img_conv_group",
     "sub_nested_seq_layer",
     "get_output_layer",
     "memory",
@@ -211,8 +223,18 @@ def data_layer(name, size, height=None, width=None, depth=None,
 
 def fc_layer(input, size, act=None, name=None, bias_attr=True,
              param_attr=None, layer_attr=None, **_):
-    return dsl.fc(*_many(input), size=size, name=name, act=_act(act),
-                  bias=bool(bias_attr), param=param_attr)
+    out = dsl.fc(*_many(input), size=size, name=name, act=_act(act),
+                 bias=bool(bias_attr), param=param_attr)
+    return _apply_layer_attr(out, layer_attr)
+
+
+def _apply_layer_attr(out, layer_attr):
+    """ExtraLayerAttribute(drop_rate=...) applies dropout on the layer
+    output (config_parser's drop_rate semantics)."""
+    rate = getattr(layer_attr, "drop_rate", None)
+    if rate:
+        return dsl.dropout(out, rate)
+    return out
 
 
 def embedding_layer(input, size, name=None, param_attr=None, **kw):
@@ -223,6 +245,14 @@ def embedding_layer(input, size, name=None, param_attr=None, **kw):
     if not vocab:
         vocab = x.builder.conf.layer(x.name).size
     assert vocab, "embedding_layer: set the word data_layer's size"
+    # in v1 the slot type (ids, sequence) came from the data-provider
+    # declaration, not the config; a data layer fed into an embedding is
+    # an id sequence, so annotate it retroactively (the provider's
+    # input_types, when available, refine this via apply_data_types)
+    lc = x.builder.conf.layer(x.name)
+    if lc.type == "data" and not lc.attrs.get("is_ids"):
+        lc.attrs["is_ids"] = True
+        lc.attrs["is_seq"] = True
     return dsl.embedding(x, size=size, vocab_size=vocab,
                          name=name, param=param_attr)
 
@@ -242,10 +272,12 @@ def dropout_layer(input, dropout_rate, name=None, **_):
 
 def img_conv_layer(input, filter_size, num_filters, stride=1, padding=0,
                    groups=1, dilation=1, act=None, name=None,
-                   bias_attr=True, param_attr=None, **_):
+                   num_channels=None, bias_attr=True, param_attr=None,
+                   **_):
     return dsl.conv(_one(input), num_filters, filter_size, stride=stride,
                     padding=padding, groups=groups, dilation=dilation,
                     name=name, act=_act_or(act, "relu"),
+                    num_channels=num_channels,
                     bias=bool(bias_attr), param=param_attr)
 
 
@@ -358,6 +390,41 @@ def sub_seq_layer(input, offsets, sizes, name=None, **_):
 def mixed_layer(size, input, act=None, name=None, bias_attr=True, **_):
     return dsl.mixed(size, _many(input), name=name, act=_act(act),
                      bias=bool(bias_attr))
+
+
+# ---- projections for mixed_layer (trainer_config_helpers/layers.py
+# full_matrix_projection:552 etc.) — each returns the (layer, proj kind)
+# edge spec dsl.mixed consumes ----
+
+def full_matrix_projection(input, size=0, param_attr=None, **_):
+    return (_one(input), "full_matrix")
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None, **_):
+    return (_one(input), "trans_full_matrix")
+
+
+def identity_projection(input, offset=None, **_):
+    assert offset is None, "identity_projection offset not supported"
+    return (_one(input), "identity")
+
+
+def dotmul_projection(input, param_attr=None, **_):
+    return (_one(input), "dotmul")
+
+
+def scaling_projection(input, param_attr=None, **_):
+    return (_one(input), "scaling")
+
+
+def table_projection(input, size=0, param_attr=None, **_):
+    return (_one(input), "table")
+
+
+def context_projection(input, context_len, context_start=None, **_):
+    start = (-(context_len // 2)) if context_start is None else context_start
+    return (_one(input), "context",
+            {"context_len": context_len, "context_start": start})
 
 
 def tensor_layer(a, b, size, act=None, name=None, bias_attr=True, **_):
@@ -546,6 +613,74 @@ def repeat_layer(input, num_repeats, name=None, **_):
 def kmax_sequence_score_layer(input, beam_size=1, name=None, **_):
     return dsl.kmax_seq_score(_one(input), beam_size=beam_size,
                               name=name)
+
+
+# ---- prebuilt networks, keyword style (networks.py) ----
+
+def simple_lstm(input, size, name=None, act=None, reverse=False,
+                lstm_cell_attr=None, **_):
+    """(networks.py:548 simple_lstm)."""
+    out = dsl.simple_lstm(_one(input), size, name=name,
+                          act=_act_or(act, "tanh"), reversed=reverse)
+    return _apply_layer_attr(out, lstm_cell_attr)
+
+
+def simple_gru(input, size, name=None, act=None, reverse=False,
+               gru_cell_attr=None, **_):
+    """(networks.py:975 simple_gru)."""
+    out = dsl.simple_gru(_one(input), size, name=name,
+                         act=_act_or(act, "tanh"), reversed=reverse)
+    return _apply_layer_attr(out, gru_cell_attr)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False, **_):
+    """(networks.py:1207 bidirectional_lstm). return_seq=False pools
+    each direction's last frame, True concats the full sequences."""
+    x = _one(input)
+    if return_seq:
+        return dsl.bidirectional_lstm(x, size, name=name)
+    fwd = dsl.simple_lstm(x, size, name=(name or "bilstm") + "_fwd")
+    bwd = dsl.simple_lstm(x, size, name=(name or "bilstm") + "_bwd",
+                          reversed=True)
+    return dsl.concat(dsl.last_seq(fwd), dsl.first_seq(bwd), name=name)
+
+
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         pool_stride=1, act=None, name=None, padding=0,
+                         num_channel=None, **_):
+    """(networks.py:145 simple_img_conv_pool)."""
+    c = dsl.conv(_one(input), num_filters, filter_size, padding=padding,
+                 act=_act_or(act, "relu"), num_channels=num_channel,
+                 name=(name or "convpool") + "_conv")
+    return dsl.pool(c, pool_size, pool_stride,
+                    name=(name or "convpool") + "_pool")
+
+
+def img_conv_group(input, conv_num_filter, conv_filter_size, pool_size,
+                   pool_stride, conv_act=None, conv_with_batchnorm=False,
+                   pool_type=None, num_channels=None, conv_padding=None,
+                   **_):
+    """A VGG block (networks.py:333 img_conv_group)."""
+    h = _one(input)
+    n = len(conv_num_filter)
+    fss = (conv_filter_size if isinstance(conv_filter_size, (list, tuple))
+           else [conv_filter_size] * n)
+    bns = (conv_with_batchnorm
+           if isinstance(conv_with_batchnorm, (list, tuple))
+           else [conv_with_batchnorm] * n)
+    act = _act_or(conv_act, "relu")
+    for i, (nf, fs, bn) in enumerate(zip(conv_num_filter, fss, bns)):
+        pad = (conv_padding[i]
+               if isinstance(conv_padding, (list, tuple))
+               else conv_padding)
+        if pad is None:
+            pad = (fs - 1) // 2
+        h = dsl.conv(h, nf, fs, padding=pad, act="" if bn else act,
+                     num_channels=num_channels if i == 0 else None)
+        if bn:
+            h = dsl.batch_norm(h, act=act)
+    return dsl.pool(h, pool_size, pool_stride,
+                    pool_type=_pool_type(pool_type))
 
 
 def simple_attention(encoded_sequence, encoded_proj, decoder_state,

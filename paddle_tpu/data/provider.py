@@ -31,6 +31,16 @@ class _Settings:
         for k, v in kwargs.items():
             setattr(self, k, v)
 
+    # older reference providers (benchmark/paddle/image/provider.py)
+    # call the field `slots`; keep both names as aliases
+    @property
+    def slots(self):
+        return self.input_types
+
+    @slots.setter
+    def slots(self, v):
+        self.input_types = v
+
 
 class DataProvider:
     def __init__(
@@ -60,6 +70,14 @@ class DataProvider:
         settings = _Settings(self.input_types, self.kwargs)
         if self.init_hook is not None:
             self.init_hook(settings, file_list=file_list, **hook_kwargs)
+        # init_hook may declare the types (settings.input_types or the
+        # older settings.slots), as in PyDataProvider2.py:150-214
+        if settings.input_types is None:
+            raise ValueError(
+                "provider has no input_types: pass them to @provider or "
+                "set settings.input_types/settings.slots in init_hook"
+            )
+        self.input_types = settings.input_types
         shuffle = (
             self.should_shuffle
             if self.should_shuffle is not None
@@ -118,7 +136,9 @@ def provider(
             for img, lbl in read(filename):
                 yield img, lbl
     """
-    assert input_types is not None, "provider needs input_types"
+    assert input_types is not None or init_hook is not None, (
+        "provider needs input_types (directly or set by init_hook)"
+    )
 
     def deco(fn):
         return DataProvider(

@@ -167,10 +167,13 @@ def mixed(size, inputs, name=None, act="", bias=True):
 # ---- image ----
 
 def conv(x, num_filters, filter_size, stride=1, padding=0, groups=1,
-         dilation=1, name=None, act="relu", bias=True, param=None):
+         dilation=1, name=None, act="relu", bias=True, param=None,
+         num_channels=None):
+    kw = {"num_channels": num_channels} if num_channels else {}
     return _add("exconv", [x], name=name, size=num_filters, act=act, bias=bias,
                 param=param, num_filters=num_filters, filter_size=filter_size,
-                stride=stride, padding=padding, groups=groups, dilation=dilation)
+                stride=stride, padding=padding, groups=groups,
+                dilation=dilation, **kw)
 
 
 def conv_trans(x, num_filters, filter_size, stride=1, padding=0, name=None,

@@ -1,0 +1,309 @@
+"""Run REFERENCE config files unmodified.
+
+The reference's v1 stack executes user config files through
+python/paddle/trainer/config_parser.py:3724 `parse_config` with the
+`paddle.trainer_config_helpers` import namespace. These tests exec the
+reference's own files from /root/reference against the repo-root
+`paddle` shim package, train the resulting models, and run
+config-equivalence checks in the trainer/tests/test_NetworkCompare.cpp
+discipline (two different configs computing the same function).
+"""
+
+import os
+import textwrap
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.compat.config_parser import (
+    load_provider_module,
+    parse_config,
+)
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+REF = "/root/reference"
+
+
+def _train_steps(tc, feed, steps=2):
+    """One-jit-program training off a parsed TrainerConfig."""
+    net = Network(tc.model)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(tc.opt, net.param_confs)
+    ost = opt.init_state(params)
+    state = net.init_state()
+
+    @jax.jit
+    def step(params, ost, state, feed, i):
+        (loss, (outs, state2)), grads = jax.value_and_grad(
+            net.loss_fn, has_aux=True
+        )(params, feed, state=state, rng=jax.random.key(i), train=True)
+        params, ost = opt.update(grads, params, ost, i)
+        return params, ost, state2, loss
+
+    losses = []
+    for i in range(steps):
+        params, ost, state, loss = step(params, ost, state, feed, i)
+        losses.append(float(loss))
+    return losses, net, params
+
+
+class TestReferenceBenchmarkConfigs:
+    def test_alexnet_config_runs_end_to_end(self):
+        """benchmark/paddle/image/alexnet.py: parse unmodified (incl.
+        --config_args interpolation), feed batches from the reference's
+        OWN provider.py (a py2 module using xrange), train 2 steps."""
+        tc = parse_config(
+            f"{REF}/benchmark/paddle/image/alexnet.py", "batch_size=8"
+        )
+        assert tc.opt.learning_method == "momentum"
+        assert tc.opt.batch_size == 8
+        assert tc.opt.learning_rate == pytest.approx(0.01 / 8)
+        assert tc.opt.l2_rate == pytest.approx(0.0005 * 8)
+
+        # the reference's own data provider generates the batch
+        mod = load_provider_module(
+            "provider", tc.data_sources.search_dir
+        )
+        reader = mod.process(["dummy.list"], **tc.data_sources.args)
+        types = mod.process.input_types  # [dense 227*227*3, int label]
+        feeding = {"data": 0, "label": 1}
+        feeder = DataFeeder(
+            feeding, {"data": types[0], "label": types[1]}
+        )
+        batch = []
+        for sample in reader():
+            batch.append(sample)
+            if len(batch) == 4:
+                break
+        feed = feeder(batch)
+        assert feed["data"].value.shape == (4, 227 * 227 * 3)
+
+        losses, _, _ = _train_steps(tc, feed, steps=2)
+        assert np.isfinite(losses).all()
+        # 1000-way CE starts near ln(1000)
+        assert 2.0 < losses[0] < 14.0
+
+    def test_rnn_benchmark_config_parses(self):
+        """benchmark/paddle/rnn/rnn.py uses xrange + get_config_arg;
+        parse with config args, skipping its imdb download import."""
+        cfg = f"{REF}/benchmark/paddle/rnn/rnn.py"
+        src = open(cfg).read()
+        assert "xrange" in src  # the py2-ism we must absorb
+        # rnn.py imports `imdb` and creates data at import time; give it
+        # a stub module on sys.path instead of network access
+        import sys
+        import types
+
+        stub = types.ModuleType("imdb")
+        stub.create_data = lambda path: None
+        sys.modules["imdb"] = stub
+        try:
+            import tempfile
+
+            with tempfile.TemporaryDirectory() as d:
+                p = os.path.join(d, "rnn.py")
+                open(p, "w").write(src)
+                tc = parse_config(
+                    p, "batch_size=4,lstm_num=2,hidden_size=16"
+                )
+        finally:
+            del sys.modules["imdb"]
+        assert tc.opt.learning_method == "adam"
+        types_ = [l.type for l in tc.model.layers]
+        assert types_.count("lstmemory") == 2
+        assert tc.model.output_layer_names
+
+
+class TestQuickStartConfigs:
+    def _setup_quick_start_data(self, tmp_path):
+        (tmp_path / "data").mkdir()
+        words = ["the", "movie", "was", "great", "bad", "awful", "good"]
+        (tmp_path / "data" / "dict.txt").write_text(
+            "".join(f"{w}\t{i}\n" for i, w in enumerate(words))
+        )
+        (tmp_path / "data" / "train.txt").write_text(
+            "1\tthe movie was great good\n"
+            "0\tthe movie was bad awful\n"
+            "1\tgreat good movie\n"
+            "0\tawful bad\n"
+        )
+        (tmp_path / "data" / "train.list").write_text("data/train.txt\n")
+        (tmp_path / "data" / "test.list").write_text("data/train.txt\n")
+        return words
+
+    def test_quick_start_lr_config_runs_end_to_end(
+        self, tmp_path, monkeypatch
+    ):
+        """v1_api_demo/quick_start/trainer_config.lr.py executes
+        UNMODIFIED (it reads ./data/dict.txt relative to cwd, exactly
+        like `paddle train` did) and trains on batches produced by the
+        reference's own dataprovider_bow.py."""
+        words = self._setup_quick_start_data(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        # the config declares train.list/test.list at data/...
+        (tmp_path / "data" / "pred.list").write_text("data/train.txt\n")
+
+        tc = parse_config(
+            f"{REF}/v1_api_demo/quick_start/trainer_config.lr.py"
+        )
+        assert tc.opt.learning_method == "adam"
+        assert tc.opt.gradient_clipping_threshold == 25
+
+        mod = load_provider_module(
+            "dataprovider_bow", tc.data_sources.search_dir
+        )
+        provider = getattr(mod, tc.data_sources.obj)
+        reader = provider(
+            [str(tmp_path / "data" / "train.txt")],
+            **tc.data_sources.args,
+        )
+        types = provider.input_types  # dict name -> type (sample dicts)
+        feeder = DataFeeder({n: n for n in types}, types)
+        batch = list(reader())
+        assert len(batch) == 4
+        feed = feeder(batch)
+        assert feed["word"].value.shape == (4, len(words))
+
+        losses, _, _ = _train_steps(tc, feed, steps=6)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]  # 2-class LR learns immediately
+
+    def test_quick_start_lstm_config_parses(self, tmp_path, monkeypatch):
+        """trainer_config.lstm.py: embedding + simple_lstm with dropout
+        cell attr + max pooling + fc, unmodified."""
+        self._setup_quick_start_data(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        tc = parse_config(
+            f"{REF}/v1_api_demo/quick_start/trainer_config.lstm.py"
+        )
+        types_ = [l.type for l in tc.model.layers]
+        assert "lstmemory" in types_ and "embedding" in types_
+        net = Network(tc.model)  # builds: shapes all consistent
+        assert tc.model.output_layer_names  # outputs(cls) recorded
+        # final softmax fc is 2-wide
+        fc_dims = [
+            net.specs[lc.name].dim
+            for lc in tc.model.layers
+            if lc.type == "fc"
+        ]
+        assert (2,) in fc_dims
+
+
+class TestNetworkCompare:
+    """Two different configs, same function — the
+    trainer/tests/test_NetworkCompare.cpp discipline (e.g. its
+    concat_dotmul_a.conf vs concat_dotmul_b.conf pairs)."""
+
+    def _run_pair(self, tmp_path, cfg_a: str, cfg_b: str, feed,
+                  share_params=False):
+        pa, pb = tmp_path / "a.py", tmp_path / "b.py"
+        pa.write_text(textwrap.dedent(cfg_a))
+        pb.write_text(textwrap.dedent(cfg_b))
+        ta, tb = parse_config(str(pa)), parse_config(str(pb))
+        na, nb = Network(ta.model), Network(tb.model)
+        params_a = na.init_params(jax.random.key(7))
+        params_b = nb.init_params(jax.random.key(7))
+        if share_params:
+            # map by sorted position: same function => same param shapes
+            ka = sorted(params_a)
+            kb = sorted(params_b)
+            assert [params_a[k].shape for k in ka] == [
+                params_b[k].shape for k in kb
+            ]
+            params_b = {
+                k2: params_a[k1] for k1, k2 in zip(ka, kb)
+            }
+        oa, _ = na.forward(params_a, feed)
+        ob, _ = nb.forward(params_b, feed)
+        return oa, ob
+
+    def test_concat_via_layer_vs_identity_projections(self, tmp_path):
+        from paddle_tpu.core.arg import non_seq
+
+        feed = {
+            "a": non_seq(np.arange(12, dtype=np.float32).reshape(2, 6) / 12),
+            "b": non_seq(np.ones((2, 6), np.float32)),
+        }
+        cfg_a = """
+            from paddle.trainer_config_helpers import *
+            a = data_layer('a', 6); b = data_layer('b', 6)
+            out = concat_layer(input=[a, b], name='out')
+            outputs(out)
+        """
+        cfg_b = """
+            from paddle.trainer_config_helpers import *
+            a = data_layer('a', 6); b = data_layer('b', 6)
+            a12 = mixed_layer(size=6, input=[identity_projection(a)],
+                              bias_attr=False, name='pa')
+            b12 = mixed_layer(size=6, input=[identity_projection(b)],
+                              bias_attr=False, name='pb')
+            out = concat_layer(input=[a12, b12], name='out')
+            outputs(out)
+        """
+        oa, ob = self._run_pair(tmp_path, cfg_a, cfg_b, feed)
+        np.testing.assert_allclose(
+            np.asarray(oa["out"].value), np.asarray(ob["out"].value),
+            atol=1e-6,
+        )
+
+    def test_fc_layer_vs_full_matrix_projection(self, tmp_path):
+        from paddle_tpu.core.arg import non_seq
+
+        feed = {"x": non_seq(
+            np.linspace(-1, 1, 2 * 5).astype(np.float32).reshape(2, 5)
+        )}
+        cfg_a = """
+            from paddle.trainer_config_helpers import *
+            x = data_layer('x', 5)
+            out = fc_layer(input=x, size=4, act=TanhActivation(),
+                           bias_attr=False, name='out')
+            outputs(out)
+        """
+        cfg_b = """
+            from paddle.trainer_config_helpers import *
+            x = data_layer('x', 5)
+            out = mixed_layer(size=4,
+                              input=[full_matrix_projection(input=x)],
+                              act=TanhActivation(), bias_attr=False,
+                              name='out')
+            outputs(out)
+        """
+        oa, ob = self._run_pair(
+            tmp_path, cfg_a, cfg_b, feed, share_params=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(oa["out"].value), np.asarray(ob["out"].value),
+            atol=1e-6,
+        )
+
+    def test_addto_vs_mixed_identity_sum(self, tmp_path):
+        from paddle_tpu.core.arg import non_seq
+
+        feed = {
+            "a": non_seq(np.arange(8, dtype=np.float32).reshape(2, 4)),
+            "b": non_seq(np.full((2, 4), 0.5, np.float32)),
+        }
+        cfg_a = """
+            from paddle.trainer_config_helpers import *
+            a = data_layer('a', 4); b = data_layer('b', 4)
+            out = addto_layer(input=[a, b], name='out')
+            outputs(out)
+        """
+        cfg_b = """
+            from paddle.trainer_config_helpers import *
+            a = data_layer('a', 4); b = data_layer('b', 4)
+            out = mixed_layer(size=4,
+                              input=[identity_projection(a),
+                                     identity_projection(b)],
+                              bias_attr=False, name='out')
+            outputs(out)
+        """
+        oa, ob = self._run_pair(tmp_path, cfg_a, cfg_b, feed)
+        np.testing.assert_allclose(
+            np.asarray(oa["out"].value), np.asarray(ob["out"].value),
+            atol=1e-6,
+        )
