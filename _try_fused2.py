@@ -1,0 +1,29 @@
+import json
+import bench
+bench._setup()
+import numpy as np
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core.arg import id_arg
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.models import stacked_lstm_classifier
+
+bs, T, hidden = 128, 100, 256
+rng = np.random.default_rng(0)
+feed = {"words": id_arg(rng.integers(0, 30000, (bs, T)).astype(np.int32), np.full((bs,), T, np.int32)),
+        "label": id_arg(rng.integers(0, 2, bs).astype(np.int32))}
+opt = OptimizationConf(learning_method="adam", learning_rate=2e-3)
+
+def run(use_fused):
+    _flags.set_flag("use_pallas_rnn", use_fused)
+    try:
+        conf = stacked_lstm_classifier(vocab_size=30000, emb_dim=128, hidden=hidden, num_layers=2, num_classes=2)
+        return bench._time_train(conf, feed, opt, iters=30, warmup=30)
+    finally:
+        _flags.set_flag("use_pallas_rnn", None)
+
+res = {"scan": [], "fused": []}
+for rep in range(3):
+    res["scan"].append(round(run(False), 3))
+    res["fused"].append(round(run(True), 3))
+print(json.dumps({"hidden": hidden, **res,
+                  "speedup_min": round(min(res["scan"]) / min(res["fused"]), 3)}))

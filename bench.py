@@ -51,9 +51,14 @@ BASELINES_MS = {
 R1_RESNET_IMG_S = 1976.0
 R1_NMT_TOK_S = 90000.0
 
-# v5e bf16 peak for MFU bookkeeping
+# v5e bf16 peak for MFU bookkeeping. The peak is specified in FLOPs
+# (2 per MAC), so the model cost must use the same convention:
+# ResNet-50 fwd ~4.1 GMACs = 8.2 GFLOP/img; train (fwd+bwd) ~3x
+# = 24.6 GFLOP/img. (XLA's own cost analysis of our compiled fwd+bwd
+# reports 22.3 GFLOP/img, consistent.) Counting MACs against a FLOP
+# peak — as round 1 did — understates MFU by 2x.
 TPU_PEAK_FLOPS = 197e12
-RESNET50_TRAIN_FLOPS_PER_IMG = 12.3e9  # ~4.1 GFLOP fwd × 3 (fwd+bwd)
+RESNET50_TRAIN_FLOPS_PER_IMG = 24.6e9
 
 
 def _setup():
@@ -159,6 +164,56 @@ def bench_lstm(bs, hidden):
     return {"value": round(ms, 3), "unit": "ms/batch"}
 
 
+def bench_lstm_fused_vs_scan(bs=128, hidden=512):
+    """Fused Pallas LSTM (fwd + reverse-time bwd kernels) vs the
+    lax.scan lowering, same TRAINING step. value = scan_ms / fused_ms
+    (>1: the kernel beats the scan path)."""
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.core.arg import id_arg
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.models import stacked_lstm_classifier
+
+    T = 100
+    rng = np.random.default_rng(0)
+    feed = {
+        "words": id_arg(
+            rng.integers(0, 30000, (bs, T)).astype(np.int32),
+            np.full((bs,), T, np.int32),
+        ),
+        "label": id_arg(rng.integers(0, 2, bs).astype(np.int32)),
+    }
+    opt = OptimizationConf(learning_method="adam", learning_rate=2e-3)
+
+    def run(use_fused):
+        try:
+            _flags.set_flag("use_pallas_rnn", use_fused)
+            conf = stacked_lstm_classifier(
+                vocab_size=30000, emb_dim=128, hidden=hidden,
+                num_layers=2, num_classes=2,
+            )
+            return _time_train(conf, feed, opt)
+        finally:
+            _flags.set_flag("use_pallas_rnn", None)
+
+    scan_ms = run(False)
+    fused_ms = run(True)
+    from paddle_tpu.ops.pallas_rnn import _lstm_bwd_plan
+
+    plan = _lstm_bwd_plan(bs, T, hidden)
+    return {
+        "value": round(scan_ms / fused_ms, 3),
+        "unit": "speedup (scan_ms / fused_ms)",
+        "scan_ms": round(scan_ms, 3),
+        "fused_ms": round(fused_ms, 3),
+        # whether the reverse-time Pallas backward kernel engaged (vs
+        # the scan-recompute fallback; it needs a batch block >= 32 to
+        # fill the MXU — see _lstm_bwd_pallas)
+        "bwd_kernel": plan is not None and plan[0] >= 32,
+        "batch_size": bs,
+        "hidden": hidden,
+    }
+
+
 def bench_resnet50(bs=256):
     from paddle_tpu.models import resnet
 
@@ -227,6 +282,8 @@ def build_sweep():
             sweep.append(
                 (f"lstm_bs{bs}_h{h}", lambda bs=bs, h=h: bench_lstm(bs, h))
             )
+    sweep.append(("lstm_train_fused_speedup_vs_scan",
+                  bench_lstm_fused_vs_scan))
     sweep.append(("resnet50_train_imgs_per_s", bench_resnet50))
     sweep.append(("nmt_attention_train_tokens_per_s", bench_nmt))
     return sweep

@@ -12,23 +12,54 @@ layer for the previous word id, static links (encoder outputs etc.),
 memories for decoder state. Its output layer must produce a probability
 distribution [*, V] (softmax output).
 
-User-callback beam hooks (RecurrentGradientMachine.h:92-152) are covered
-by `logprob_fn`: an optional host-side-free JAX fn applied to the step's
-log-probs before expansion (e.g. masking illegal words).
+User-callback beam hooks (RecurrentGradientMachine.h:92-152
+registerBeamSearchControlCallbacks): `BeamHooks` carries plain-Python
+callbacks executed HOST-SIDE each step via `jax.pure_callback` —
+`adjust` rewrites candidate log-probs before expansion (the
+BeamSearchCandidatesAdjustCallback), `drop` truncates/renormalizes
+selected beams (NormOrDropNodeCallback/DropCallback), `stop` ends the
+whole generation early (stopBeamSearch). A purely-JAX `logprob_fn` is
+still available for hooks that don't need host code. Generation runs in
+a `lax.while_loop` that exits as soon as every beam has emitted EOS (or
+a stop hook fires) — no fixed worst-case step count.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.arg import Arg
 from paddle_tpu.core.config import LayerConf, ModelConf
 from paddle_tpu.network import Network
 
 NEG_INF = -1e30
+
+
+@dataclass
+class BeamHooks:
+    """Host-side beam-search control callbacks
+    (RecurrentGradientMachine.h:92-152). All are optional plain-Python
+    functions receiving numpy arrays:
+
+    - adjust(logp [B,K,V] f32, t int) -> [B,K,V] f32 — rewrite the
+      step's candidate log-probs before expansion (forbid words, add
+      user priors): BeamSearchCandidatesAdjustCallback.
+    - drop(words [B,K] i32, scores [B,K] f32, t int) ->
+      (scores [B,K] f32, drop_mask [B,K] bool) — renormalize selected
+      beams and/or mark beams to truncate (they finish at this step
+      with score NEG_INF): NormOrDropNodeCallback + DropCallback.
+    - stop(finished [B,K] bool, scores [B,K] f32, t int) -> bool —
+      end the whole generation now: stopBeamSearch.
+    """
+
+    adjust: Optional[Callable] = None
+    drop: Optional[Callable] = None
+    stop: Optional[Callable] = None
 
 
 class BeamSearchDecoder:
@@ -56,6 +87,7 @@ class BeamSearchDecoder:
         max_length: int,
         logprob_fn: Optional[Callable] = None,
         static_sizes: Optional[list] = None,
+        hooks: Optional[BeamHooks] = None,
     ):
         """`static_sizes` (optional, one int per static input) stamps
         the static stubs' sizes so size-dependent config helpers (e.g.
@@ -72,6 +104,7 @@ class BeamSearchDecoder:
         self.k = beam_size
         self.max_length = max_length
         self.logprob_fn = logprob_fn
+        self.hooks = hooks or BeamHooks()
 
         with dsl.model() as sub:
             word = sub.add(
@@ -158,8 +191,10 @@ class BeamSearchDecoder:
                     (b * k, m["size"]), m.get("boot_value", 0.0), jnp.float32
                 )
 
-        def body(carry, _):
-            mems, words, scores, finished, t = carry
+        hooks = self.hooks
+        t_max = self.max_length
+
+        def step_once(mems, words, scores, finished, t):
             feed = dict(static_feed)
             feed["@word"] = Arg(ids=words.reshape(b * k))
             for m in self.memories:
@@ -170,6 +205,17 @@ class BeamSearchDecoder:
             logp = jnp.log(jnp.maximum(prob, 1e-20)).reshape(b, k, v)
             if self.logprob_fn is not None:
                 logp = self.logprob_fn(logp, t)
+            if hooks.adjust is not None:
+                # BeamSearchCandidatesAdjustCallback: host code rewrites
+                # the candidate log-probs
+                logp = jax.pure_callback(
+                    lambda lp, tt: np.asarray(
+                        hooks.adjust(np.asarray(lp), int(tt)),
+                        np.float32,
+                    ),
+                    jax.ShapeDtypeStruct((b, k, v), jnp.float32),
+                    logp, t,
+                )
             # finished beams only extend with eos at no cost
             fin_row = jnp.full((v,), NEG_INF).at[self.eos_id].set(0.0)
             logp = jnp.where(finished[..., None], fin_row[None, None, :], logp)
@@ -192,36 +238,87 @@ class BeamSearchDecoder:
                 ).reshape(b * k, -1)
             was_fin = jnp.take_along_axis(finished, parent, axis=1)
             new_fin = was_fin | (word == self.eos_id)
-            return (
-                (new_mems, word, top_scores, new_fin, t + 1),
-                (word, parent, new_fin),
-            )
+            if hooks.drop is not None:
+                # NormOrDropNodeCallback/DropCallback: host code
+                # renormalizes selected beams and truncates dropped ones
+                def _drop(wd, sc, tt):
+                    s2, dm = hooks.drop(
+                        np.asarray(wd), np.asarray(sc), int(tt)
+                    )
+                    return (
+                        np.asarray(s2, np.float32),
+                        np.asarray(dm, bool),
+                    )
 
+                top_scores, drop_mask = jax.pure_callback(
+                    _drop,
+                    (
+                        jax.ShapeDtypeStruct((b, k), jnp.float32),
+                        jax.ShapeDtypeStruct((b, k), bool),
+                    ),
+                    word, top_scores, t,
+                )
+                top_scores = jnp.where(drop_mask, NEG_INF, top_scores)
+                new_fin = new_fin | drop_mask
+            user_stop = jnp.asarray(False)
+            if hooks.stop is not None:
+                user_stop = jax.pure_callback(
+                    lambda f, s, tt: bool(
+                        hooks.stop(np.asarray(f), np.asarray(s), int(tt))
+                    ),
+                    jax.ShapeDtypeStruct((), bool),
+                    new_fin, top_scores, t,
+                )
+            return new_mems, word, parent, top_scores, new_fin, user_stop
+
+        # while-loop with preallocated trace buffers: exits as soon as
+        # every beam has finished (or a stop hook fires) instead of
+        # always paying max_length steps. Unwritten steps hold
+        # (word=eos, parent=identity), which backtraces benignly.
         words0 = jnp.full((b, k), self.bos_id, jnp.int32)
         scores0 = jnp.full((b, k), NEG_INF).at[:, 0].set(0.0)
         fin0 = jnp.zeros((b, k), bool)
-        carry0 = (init_carry_mem, words0, scores0, fin0, jnp.int32(0))
-        (mems, words, scores, finished, _), (ws, ps, fs) = jax.lax.scan(
-            body, carry0, None, length=self.max_length
+        idk = jnp.broadcast_to(
+            jnp.arange(k, dtype=jnp.int32)[None, :], (b, k)
         )
-        # backtrace beam parents to recover sequences
-        t = self.max_length
+        ws0 = jnp.full((t_max, b, k), self.eos_id, jnp.int32)
+        ps0 = jnp.broadcast_to(idk[None], (t_max, b, k))
+        state0 = (
+            init_carry_mem, words0, scores0, fin0, jnp.int32(0),
+            jnp.asarray(False), ws0, ps0,
+        )
 
+        def cond(state):
+            _, _, _, finished, t, stop, _, _ = state
+            return (t < t_max) & ~stop & ~jnp.all(finished)
+
+        def body(state):
+            mems, words, scores, finished, t, _, ws, ps = state
+            new_mems, word, parent, scores, new_fin, user_stop = (
+                step_once(mems, words, scores, finished, t)
+            )
+            ws = ws.at[t].set(word)
+            ps = ps.at[t].set(parent)
+            return (
+                new_mems, word, scores, new_fin, t + 1, user_stop, ws, ps,
+            )
+
+        _, _, scores, finished, t_end, _, ws, ps = jax.lax.while_loop(
+            cond, body, state0
+        )
+
+        # backtrace beam parents to recover sequences
         def back(nxt_parent, step_out):
-            w_t, p_t, _ = step_out
+            w_t, p_t = step_out
             w = jnp.take_along_axis(w_t, nxt_parent, axis=1)
             p = jnp.take_along_axis(p_t, nxt_parent, axis=1)
             return p, w
 
-        last_parent = jnp.broadcast_to(
-            jnp.arange(k, dtype=jnp.int32)[None, :], (b, k)
-        )
-        _, seq_rev = jax.lax.scan(back, last_parent, (ws, ps, fs),
-                                  reverse=True)
+        _, seq_rev = jax.lax.scan(back, idk, (ws, ps), reverse=True)
         seqs = seq_rev.transpose(1, 2, 0)  # [B,K,T]
         # length = position of first eos + 1 (or max_length)
         is_eos = seqs == self.eos_id
         any_eos = jnp.any(is_eos, axis=-1)
         first_eos = jnp.argmax(is_eos, axis=-1)
-        lens = jnp.where(any_eos, first_eos + 1, t).astype(jnp.int32)
+        lens = jnp.where(any_eos, first_eos + 1, t_max).astype(jnp.int32)
         return seqs, lens, scores

@@ -88,3 +88,16 @@ def id_arg(ids: jax.Array, seq_lens=None) -> Arg:
     if seq_lens is not None:
         seq_lens = jnp.asarray(seq_lens, jnp.int32)
     return Arg(ids=jnp.asarray(ids, jnp.int32), seq_lens=seq_lens)
+
+
+def sub_seq(value: jax.Array, subseq_lens: jax.Array,
+            is_ids: bool = False) -> Arg:
+    """Nested sequence: flat-packed [B, T, ...] value with [B, S]
+    per-subsequence lengths (Argument.h:84-93
+    subSequenceStartPositions). seq_lens is the flat total."""
+    subseq_lens = jnp.asarray(subseq_lens, jnp.int32)
+    lens = jnp.sum(subseq_lens, axis=1)
+    if is_ids:
+        return Arg(ids=jnp.asarray(value, jnp.int32), seq_lens=lens,
+                   subseq_lens=subseq_lens)
+    return Arg(value=value, seq_lens=lens, subseq_lens=subseq_lens)

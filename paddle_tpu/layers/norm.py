@@ -10,6 +10,7 @@ mean/var live in network *state*, not params — they are not differentiated
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from paddle_tpu.core.arg import Arg
 from paddle_tpu.core.config import ParameterConf
@@ -55,31 +56,52 @@ class BatchNormLayer(Layer):
         x = arg.value
         st = ctx.state[self.name]
         red = tuple(range(x.ndim - 1))
+        f32 = jnp.float32
+        # Stats in ONE pass over x (E[x], E[x^2] — XLA fuses both
+        # reduces into a single read of the bf16 activation; the f32
+        # converts fuse INTO the reduces, so no full-size f32 tensor is
+        # ever materialized). The normalize is then a per-channel affine
+        # y = x*scale + offset applied in x's own dtype — under bf16 AMP
+        # this keeps the whole BN layer at one bf16 read + one bf16
+        # write, which is what makes ResNet HBM traffic sane.
         if use_global:
             mean, var = st["mean"], st["var"]
             ctx.updated_state[self.name] = st
         elif arg.is_seq:
             # mask padded timesteps out of the statistics: padding must
             # never affect results (framework invariant; see core/arg.py)
-            m = arg.mask(x.dtype).reshape(x.shape[:2] + (1,) * (x.ndim - 2))
+            m = arg.mask(f32).reshape(x.shape[:2] + (1,) * (x.ndim - 2))
             n = jnp.maximum(jnp.sum(m), 1.0) * (
                 x.size / (x.shape[0] * x.shape[1] * x.shape[-1])
             )
-            mean = jnp.sum(x * m, axis=red) / n
-            var = jnp.sum(jnp.square(x - mean) * m, axis=red) / n
+            # square in x's own dtype, ACCUMULATE in f32: squaring an
+            # f32 upcast would make autodiff save the full-size f32
+            # tensor for the backward (822MB per stem BN at bs=256);
+            # squaring the bf16 value saves only x, which the conv
+            # backward already keeps
+            mean = jnp.sum(x * m.astype(x.dtype), axis=red,
+                           dtype=f32) / n
+            msq = jnp.sum(jnp.square(x) * m.astype(x.dtype), axis=red,
+                          dtype=f32) / n
+            var = jnp.maximum(msq - jnp.square(mean), 0.0)
             ctx.updated_state[self.name] = {
                 "mean": st["mean"] * frac + mean * (1 - frac),
                 "var": st["var"] * frac + var * (1 - frac),
             }
         else:
-            mean = jnp.mean(x, axis=red)
-            var = jnp.var(x, axis=red)
+            # see the masked branch: square in x's dtype + f32
+            # accumulation keeps autodiff from saving an f32 upcast
+            mean = jnp.mean(x, axis=red, dtype=f32)
+            msq = jnp.mean(jnp.square(x), axis=red, dtype=f32)
+            var = jnp.maximum(msq - jnp.square(mean), 0.0)
             ctx.updated_state[self.name] = {
                 "mean": st["mean"] * frac + mean * (1 - frac),
                 "var": st["var"] * frac + var * (1 - frac),
             }
-        inv = jnp.reciprocal(jnp.sqrt(var + eps))
-        y = (x - mean) * inv * params["w0"] + params["b"]
+        inv = lax.rsqrt(var.astype(f32) + eps)
+        scale = params["w0"].astype(f32) * inv
+        offset = params["b"].astype(f32) - mean.astype(f32) * scale
+        y = x * scale.astype(x.dtype) + offset.astype(x.dtype)
         y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
         return Arg(value=y, seq_lens=arg.seq_lens)
 

@@ -60,12 +60,19 @@ class RecurrentGroupLayer(Layer):
         n_static = len(self.static_links)
         self._in_specs = in_specs
         boot_specs = in_specs[n_in + n_static:]
+        # Nested (two-level) sequences: when the in-links are
+        # sub-sequences (Argument.h:84-93 subSequenceStartPositions),
+        # the OUTER walk is over subsequences and each step sees one
+        # subsequence as a plain sequence — the
+        # RecurrentGradientMachine's hierarchical-RNN semantics
+        # (RecurrentGradientMachine.cpp sequence-level > 0).
+        self.nested = bool(in_specs) and in_specs[0].has_subseq
 
         # fill step-net data layer dims from parent specs
         for i, link in enumerate(self.in_links):
             lc = step_conf.layer(link)
             lc.attrs["dim"] = tuple(in_specs[i].dim)
-            lc.attrs["is_seq"] = False
+            lc.attrs["is_seq"] = self.nested
             lc.attrs["is_ids"] = in_specs[i].is_ids
         for i, link in enumerate(self.static_links):
             s = in_specs[n_in + i]
@@ -103,8 +110,16 @@ class RecurrentGroupLayer(Layer):
         pcs = dict(self.step_net.param_confs)
         out_spec = self.step_net.specs[self.out_links[0]]
         self._out_specs = [self.step_net.specs[o] for o in self.out_links]
+        # nested mode: a sequence-valued step output stays a nested
+        # sequence; a scalar-per-subsequence output (e.g. last_seq of an
+        # inner rnn) becomes a plain sequence over subsequences
         return (
-            Spec(dim=out_spec.dim, is_seq=True, is_ids=out_spec.is_ids),
+            Spec(
+                dim=out_spec.dim,
+                is_seq=True,
+                is_ids=out_spec.is_ids,
+                has_subseq=self.nested and out_spec.is_seq,
+            ),
             pcs,
         )
 
@@ -112,7 +127,12 @@ class RecurrentGroupLayer(Layer):
         """Secondary out_links, registered by Network under their step-net
         layer names so parent layers can consume them."""
         return {
-            o: Spec(dim=s.dim, is_seq=True, is_ids=s.is_ids)
+            o: Spec(
+                dim=s.dim,
+                is_seq=True,
+                is_ids=s.is_ids,
+                has_subseq=self.nested and s.is_seq,
+            )
             for o, s in zip(self.out_links[1:], self._out_specs[1:])
         }
 
@@ -127,6 +147,8 @@ class RecurrentGroupLayer(Layer):
         return jnp.full((bsz, m["size"]), m.get("boot_value", 0.0), dtype)
 
     def forward(self, params, inputs, ctx):
+        if self.nested:
+            return self._forward_nested(params, inputs, ctx)
         n_in = len(self.in_links)
         n_static = len(self.static_links)
         seq_arg = inputs[0]
@@ -204,6 +226,142 @@ class RecurrentGroupLayer(Layer):
                 outs.append(Arg(ids=y, seq_lens=seq_lens))
             else:
                 outs.append(Arg(value=y, seq_lens=seq_lens))
+        self._extra_outs = {
+            o: outs[i] for i, o in enumerate(self.out_links[1:], start=1)
+        }
+        return outs[0]
+
+    # ---- nested (two-level) sequences --------------------------------
+
+    def _forward_nested(self, params, inputs, ctx):
+        """Outer scan over SUBSEQUENCES (RecurrentGradientMachine.cpp's
+        hierarchical mode, Argument.h:84-93): each outer step feeds the
+        step net ONE subsequence as a plain sequence; memories carry
+        across subsequences (masked through empty/padded ones).
+
+        Layout: a nested Arg is flat-packed [B, T, ...] with
+        subseq_lens [B, S]. The in-links are unpacked once into dense
+        [B, S, L, ...] (L = longest subsequence bound, default T), the
+        outer scan runs over S, and sequence-valued outputs are packed
+        back into the flat nested layout."""
+        n_in = len(self.in_links)
+        n_static = len(self.static_links)
+        seq_arg = inputs[0]
+        sub_lens = seq_arg.subseq_lens  # [B, S]
+        bsz, t = seq_arg.batch, seq_arg.max_len
+        s_max = sub_lens.shape[1]
+        dtype = jnp.float32
+        lcap = self.conf.attrs.get("max_subseq_len") or t
+        l = min(lcap, t)
+
+        # flat offsets of each subsequence start (exclusive prefix sum)
+        # — from the ORIGINAL lengths, which define the flat layout
+        csum = jnp.cumsum(sub_lens, axis=1)
+        offsets = jnp.concatenate(
+            [jnp.zeros((bsz, 1), sub_lens.dtype), csum[:, :-1]], axis=1
+        )  # [B, S]
+        # a max_subseq_len below the data's longest subsequence
+        # TRUNCATES each subsequence to l steps; all step feeds, masks
+        # and output metadata use the clamped lengths
+        sub_lens = jnp.minimum(sub_lens, l)
+        pos = jnp.arange(l, dtype=sub_lens.dtype)  # [L]
+        idx = offsets[:, :, None] + pos[None, None, :]  # [B, S, L]
+        valid = pos[None, None, :] < sub_lens[:, :, None]
+        idx = jnp.clip(idx, 0, t - 1)
+
+        def unpack(flat):  # [B, T, ...] -> [B, S, L, ...]
+            return jax.vmap(lambda xb, ib: xb[ib])(flat, idx)
+
+        order = (
+            jnp.arange(s_max - 1, -1, -1)
+            if self.reversed
+            else jnp.arange(s_max)
+        )
+
+        xs_vals = []
+        for i in range(n_in):
+            a = inputs[i]
+            v = a.ids if a.ids is not None else a.value
+            nested = unpack(v)[:, order]  # [B, S, L, ...]
+            xs_vals.append(nested.swapaxes(0, 1))  # [S, B, L, ...]
+        sub_lens_s = sub_lens[:, order].swapaxes(0, 1)  # [S, B]
+
+        static_feed = {}
+        for i, link in enumerate(self.static_links):
+            static_feed[link] = inputs[n_in + i]
+
+        init_carry = {
+            m["layer"]: self._boot(m, inputs, bsz, dtype)
+            for m in self.memories
+        }
+        out_is_seq = [s.is_seq for s in self._out_specs]
+
+        def body(carry, inp):
+            lens_s = inp[-1]  # [B] this subsequence's lengths
+            m_s = (lens_s > 0).astype(dtype)[:, None]
+            feed = dict(static_feed)
+            for i, link in enumerate(self.in_links):
+                x_s = inp[i]  # [B, L, ...]
+                if self._in_specs[i].is_ids:
+                    feed[link] = Arg(ids=x_s, seq_lens=lens_s)
+                else:
+                    feed[link] = Arg(value=x_s, seq_lens=lens_s)
+            for m in self.memories:
+                feed[m["link"]] = Arg(value=carry[m["layer"]])
+            outs, _ = self.step_net.forward(
+                params, feed, train=ctx.train, rng=ctx.rng
+            )
+            new_carry = {}
+            for m in self.memories:
+                new_v = outs[m["layer"]].value
+                prev = carry[m["layer"]]
+                new_carry[m["layer"]] = (
+                    m_s * new_v + (1.0 - m_s) * prev
+                ).astype(prev.dtype)
+            ys = []
+            for o in self.out_links:
+                out_a = outs[o]
+                y = out_a.ids if out_a.ids is not None else out_a.value
+                if y.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+                    y = y * m_s.reshape(
+                        (bsz,) + (1,) * (y.ndim - 1)
+                    ).astype(y.dtype)
+                ys.append(y)
+            return new_carry, tuple(ys)
+
+        xs = tuple(xs_vals) + (sub_lens_s,)
+        _, ys = jax.lax.scan(body, init_carry, xs)
+
+        n_subseq = jnp.sum((sub_lens > 0).astype(jnp.int32), axis=1)
+        inv_order = order  # reversing twice restores the order
+        outs = []
+        for i, y in enumerate(ys):
+            y = y.swapaxes(0, 1)[:, inv_order]  # [B, S, ...] outer order
+            spec = self._out_specs[i]
+            if out_is_seq[i]:
+                # pack inner sequences back into the flat nested layout
+                d = y.shape[3:]
+                y2 = (y * valid.reshape(valid.shape + (1,) * len(d))
+                      .astype(y.dtype)).reshape((bsz, s_max * l) + d)
+                flat_idx = idx.reshape(bsz, s_max * l)
+                flat = jax.vmap(
+                    lambda acc_i, yv: jnp.zeros((t,) + d, y.dtype)
+                    .at[acc_i]
+                    .add(yv)
+                )(flat_idx, y2)
+                arg = Arg(
+                    value=None if spec.is_ids else flat,
+                    ids=flat if spec.is_ids else None,
+                    seq_lens=seq_arg.seq_lens,
+                    subseq_lens=sub_lens,
+                )
+            else:
+                arg = Arg(
+                    value=None if spec.is_ids else y,
+                    ids=y if spec.is_ids else None,
+                    seq_lens=n_subseq,
+                )
+            outs.append(arg)
         self._extra_outs = {
             o: outs[i] for i, o in enumerate(self.out_links[1:], start=1)
         }

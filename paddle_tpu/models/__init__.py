@@ -7,6 +7,7 @@ from paddle_tpu.models.image import (  # noqa: F401
     vgg16,
 )
 from paddle_tpu.models.text import (  # noqa: F401
+    hierarchical_lstm_classifier,
     bidi_lstm_tagger,
     linear_crf_tagger,
     rnn_crf_tagger,

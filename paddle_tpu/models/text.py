@@ -192,3 +192,46 @@ def seq2seq_attention_decoder(
     step = _attention_decoder_step(hidden, trg_vocab, emb_dim)
     return BeamSearchDecoder(step, n_static=1, bos_id=bos_id, eos_id=eos_id,
                              beam_size=beam_size, max_length=max_length)
+
+
+def hierarchical_lstm_classifier(
+    vocab_size=1000,
+    emb_dim=16,
+    hidden=32,
+    num_classes=2,
+) -> ModelConf:
+    """Two-level document classifier over NESTED sequences (words
+    grouped into sentences): the outer recurrent group walks sentences,
+    its step encodes one sentence (embedding + rnn, last state) and
+    chains a document memory across sentences — the
+    RecurrentGradientMachine hierarchical mode
+    (gserver/gradientmachines/RecurrentGradientMachine.cpp nested
+    sequences, parameter/Argument.h:84-93; config analogue of the
+    reference's gserver/tests/sequence_nest_rnn.conf)."""
+    with dsl.model() as g:
+        words = dsl.data("words", (1,), is_seq=True, is_ids=True,
+                         has_subseq=True)
+        lbl = dsl.data("label", (1,), is_ids=True)
+
+        def sentence_step(w_sub):
+            doc_prev = dsl.memory("doc", size=hidden)
+            emb = dsl.embedding(w_sub, size=emb_dim,
+                                vocab_size=vocab_size, name="word_emb")
+            enc = dsl.recurrent(
+                dsl.fc(emb, size=hidden, bias=True, name="sent_proj"),
+                size=hidden, act="tanh", name="sent_rnn",
+            )
+            last = dsl.last_seq(enc, name="sent_vec")
+            return dsl.mixed(
+                hidden,
+                [(last, "identity"), (doc_prev, "full_matrix")],
+                act="tanh", bias=False, name="doc",
+            )
+
+        sent_seq = dsl.recurrent_group(sentence_step, [words],
+                                       name="doc_enc")
+        pooled = dsl.last_seq(sent_seq, name="doc_vec")
+        out = dsl.fc(pooled, size=num_classes, name="output")
+        dsl.classification_cost(out, lbl)
+        g.conf.output_layer_names.append("output")
+    return g.conf

@@ -12,9 +12,24 @@ Numerics match the `lax.scan` reference implementations (`lstm_ref`,
 the state carries through unchanged and the output is zeroed (the
 SequenceToBatch contract, gserver/layers/SequenceToBatch.h).
 
-Backward: `jax.custom_vjp` recomputes through the reference scan — exact
-gradients at the cost of one recompute (the standard rematerialization
-trade; forward/inference gets the full kernel win).
+Layout: the grid is (batch blocks, time blocks); time blocks stream
+through VMEM (double-buffered by the Pallas pipeline) while the h/c
+carry lives in VMEM scratch across the whole time sweep, so VMEM usage
+is O(bb·tb·h) regardless of sequence length. Batch and time are padded
+to multiples of 8 (Mosaic's sublane constraint); padded rows/steps are
+masked out by the length mask, so padding is numerically free.
+
+Backward (LSTM): a REVERSE-time Pallas kernel (`_lstm_bwd_kernel`) —
+time blocks visited back-to-front via the index map, gates recomputed
+from the saved y/c sequences (one extra matmul per step, the standard
+memory/FLOP trade), dW/db accumulated across the whole grid in resident
+output blocks. GRU backward still recomputes through the scan reference.
+
+When the plan does not fit VMEM (forward: w alone is h·4h floats;
+backward keeps w AND the dW accumulator resident, so it falls back
+earlier, around h~512-700) the
+fused path falls back to `lax.scan` — at that size the per-step matmul
+is MXU-bound anyway, which is exactly when the fusion win vanishes.
 
 Gate orders match the layer/bias layouts in layers/recurrent.py:
 LSTM [i, f, g, o] with peepholes (wci, wcf, wco); GRU [u, r | c].
@@ -30,18 +45,48 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-_VMEM_BUDGET = 8 * 1024 * 1024  # soft per-block budget (VMEM is ~16MB)
+_VMEM_BUDGET = 10 * 1024 * 1024  # soft planning budget (VMEM is ~16MB)
+# the backward keeps BOTH w and the resident dW accumulator in VMEM
+# (h=512: 2 x 4MB) — give it a larger share so h=512 training stays on
+# the kernel path; Mosaic still owns the hard limit
+_VMEM_BUDGET_BWD = 13 * 1024 * 1024
 
 
-def _batch_block(b: int, t: int, feat: int, out: int) -> int:
-    """Largest divisor of `b` whose x+y blocks fit the VMEM budget."""
-    per_row = (t * feat + t * out + 8 * out) * 4
-    cap = max(1, _VMEM_BUDGET // max(per_row, 1))
-    bb = 1
-    for d in range(1, b + 1):
-        if b % d == 0 and d <= cap:
-            bb = d
-    return bb
+def _round8(n: int) -> int:
+    return -(-n // 8) * 8
+
+
+def _plan(b: int, t: int, h: int, tok_bytes: int, fixed_bytes: int,
+          budget: int = None):
+    """Choose (bb, tb, Bp, Tp): batch block, time block, padded dims.
+
+    Constraints (Mosaic): bb and tb multiples of 8 (or the full padded
+    dim). Preference: the largest bb (per-step recurrent matmul is
+    [bb, h] @ [h, 4h] — more rows, better MXU utilization), then the
+    largest tb (fewer grid steps). Returns None if even the minimal
+    block overflows the budget (weights too big for VMEM -> caller
+    falls back to the scan path)."""
+    if budget is None:  # resolved at call time (tests patch the global)
+        budget = _VMEM_BUDGET
+    bp = _round8(b)
+    t8 = _round8(t)
+    tb_options = [t8] + [x for x in (256, 128, 64, 32, 16, 8) if x < t8]
+    bb_options = [bb for bb in range(bp, 7, -8) if bp % bb == 0]
+    for bb in bb_options:
+        for tb in tb_options:
+            if fixed_bytes + bb * tb * tok_bytes <= budget:
+                tp = -(-t // tb) * tb
+                return bb, tb, bp, tp
+    return None
+
+
+def _pad_bt(x, bp, tp):
+    """Zero-pad [B, T, ...] to [Bp, Tp, ...]."""
+    pads = [(0, bp - x.shape[0]), (0, tp - x.shape[1])]
+    pads += [(0, 0)] * (x.ndim - 2)
+    if bp == x.shape[0] and tp == x.shape[1]:
+        return x
+    return jnp.pad(x, pads)
 
 
 # ---------------------------------------------------------------- LSTM
@@ -79,98 +124,346 @@ def lstm_ref(x, w, gb, wci, wcf, wco, lens):
     return ys.swapaxes(0, 1)
 
 
-def _lstm_kernel(x_ref, w_ref, b_ref, lens_ref, y_ref, h_scr, c_scr):
-    bb, t_max, h4 = x_ref.shape
+def _make_lstm_fwd_kernel(emit_c: bool):
+    """One (batch block, time block) step. Carries h/c in VMEM scratch
+    across the time sweep; emits the masked output y and (training
+    only) the carried cell sequence c for the backward kernel —
+    inference skips the c store to halve output HBM traffic."""
+
+    def kernel(x_ref, w_ref, b_ref, lens_ref, y_ref, *rest):
+        if emit_c:
+            c_ref, h_scr, c_scr = rest
+        else:
+            h_scr, c_scr = rest
+        bb, tb, h4 = x_ref.shape
+        h = h4 // 4
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            h_scr[:] = jnp.zeros_like(h_scr)
+            c_scr[:] = jnp.zeros_like(c_scr)
+
+        gb = b_ref[0, : 4 * h]
+        wci = b_ref[0, 4 * h : 5 * h]
+        wcf = b_ref[0, 5 * h : 6 * h]
+        wco = b_ref[0, 6 * h : 7 * h]
+        lens = lens_ref[:, 0]
+        t0 = j * tb
+
+        def body(tt, _):
+            x_t = x_ref[:, tt, :]
+            h_prev = h_scr[:]
+            c_prev = c_scr[:]
+            g = (
+                x_t
+                + jnp.dot(
+                    h_prev, w_ref[:], preferred_element_type=jnp.float32
+                )
+                + gb
+            )
+            i = jax.nn.sigmoid(g[:, :h] + wci * c_prev)
+            f = jax.nn.sigmoid(g[:, h : 2 * h] + wcf * c_prev)
+            cand = jnp.tanh(g[:, 2 * h : 3 * h])
+            c = f * c_prev + i * cand
+            o = jax.nn.sigmoid(g[:, 3 * h :] + wco * c)
+            out = o * jnp.tanh(c)
+            m = (t0 + tt < lens).astype(jnp.float32)[:, None]
+            h_scr[:] = m * out + (1 - m) * h_prev
+            c_scr[:] = m * c + (1 - m) * c_prev
+            y_ref[:, tt, :] = (out * m).astype(y_ref.dtype)
+            if emit_c:
+                c_ref[:, tt, :] = c_scr[:].astype(c_ref.dtype)
+            return 0
+
+        lax.fori_loop(0, tb, body, 0)
+
+    return kernel
+
+
+_lstm_fwd_kernel = _make_lstm_fwd_kernel(emit_c=True)
+_lstm_fwd_kernel_noc = _make_lstm_fwd_kernel(emit_c=False)
+
+
+def _lstm_bwd_kernel(
+    x_ref, w_ref, b_ref, lens_ref, y_ref, yp_ref, c_ref, cp_ref, dy_ref,
+    dx_ref, dw_ref, db_ref, dh_scr, dc_scr, dg_scr, hp_scr, db_scr,
+):
+    """Reverse-time LSTM backward. Grid blocks arrive back-to-front in
+    time (see the reversed index maps); within a block, steps run in
+    reverse. Gates are recomputed from x and the saved y/c sequences.
+    yp/cp are the PREVIOUS time block of y/c (their last row supplies
+    h_{t-1}/c_{t-1} at the block boundary). dW/db accumulate into
+    resident output blocks across the whole grid."""
+    bb, tb, h4 = x_ref.shape
     h = h4 // 4
-    h_scr[:] = jnp.zeros_like(h_scr)
-    c_scr[:] = jnp.zeros_like(c_scr)
+    i_blk = pl.program_id(0)
+    j = pl.program_id(1)
+    nt = pl.num_programs(1)
+    # reversed sweep: this grid step handles time block k = nt-1-j
+    k = nt - 1 - j
+    t0 = k * tb
+
+    @pl.when(j == 0)
+    def _init_carry():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dc_scr[:] = jnp.zeros_like(dc_scr)
+
+    @pl.when((i_blk == 0) & (j == 0))
+    def _init_outs():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    db_scr[:] = jnp.zeros_like(db_scr)
+
     gb = b_ref[0, : 4 * h]
     wci = b_ref[0, 4 * h : 5 * h]
     wcf = b_ref[0, 5 * h : 6 * h]
     wco = b_ref[0, 6 * h : 7 * h]
     lens = lens_ref[:, 0]
+    w = w_ref[:]
 
-    def body(t, _):
-        x_t = x_ref[:, t, :]
-        h_prev = h_scr[:]
-        c_prev = c_scr[:]
+    def body(s, _):
+        tt = tb - 1 - s
+        t = t0 + tt
+        m = (t < lens).astype(jnp.float32)[:, None]
+        first = t == 0
+        # h_{t-1}, c_{t-1}: previous row of this block, or the last row
+        # of the previous time block at the boundary, or zeros at t=0
+        tt_prev = jnp.maximum(tt - 1, 0)
+        in_blk = (tt > 0).astype(jnp.float32)
+        h_prev_blk = y_ref[:, tt_prev, :]
+        c_prev_blk = c_ref[:, tt_prev, :]
+        h_prev_edge = yp_ref[:, tb - 1, :]
+        c_prev_edge = cp_ref[:, tb - 1, :]
+        zero = jnp.float32(0.0)
+        live = jnp.where(first, zero, 1.0)
+        h_prev = live * (
+            in_blk * h_prev_blk + (1 - in_blk) * h_prev_edge
+        )
+        c_prev = live * (
+            in_blk * c_prev_blk + (1 - in_blk) * c_prev_edge
+        )
+        # recompute the forward cell (valid wherever m = 1)
         g = (
-            x_t
-            + jnp.dot(h_prev, w_ref[:], preferred_element_type=jnp.float32)
+            x_ref[:, tt, :]
+            + jnp.dot(h_prev, w, preferred_element_type=jnp.float32)
             + gb
         )
-        gi = g[:, :h]
-        gf = g[:, h : 2 * h]
-        gg = g[:, 2 * h : 3 * h]
-        go = g[:, 3 * h :]
-        i = jax.nn.sigmoid(gi + wci * c_prev)
-        f = jax.nn.sigmoid(gf + wcf * c_prev)
-        cand = jnp.tanh(gg)
-        c = f * c_prev + i * cand
-        o = jax.nn.sigmoid(go + wco * c)
-        out = o * jnp.tanh(c)
-        m = (t < lens).astype(jnp.float32)[:, None]
-        h_scr[:] = m * out + (1 - m) * h_prev
-        c_scr[:] = m * c + (1 - m) * c_prev
-        # state stays float32 in VMEM; the output ref may be bfloat16
-        # under AMP — cast at the store
-        y_ref[:, t, :] = (out * m).astype(y_ref.dtype)
+        ig = jax.nn.sigmoid(g[:, :h] + wci * c_prev)
+        fg = jax.nn.sigmoid(g[:, h : 2 * h] + wcf * c_prev)
+        cand = jnp.tanh(g[:, 2 * h : 3 * h])
+        c_t = fg * c_prev + ig * cand
+        og = jax.nn.sigmoid(g[:, 3 * h :] + wco * c_t)
+        tanh_c = jnp.tanh(c_t)
+        # backward through the step
+        dh_in = dh_scr[:]
+        dc_in = dc_scr[:]
+        dout = m * (dh_in + dy_ref[:, tt, :])
+        dg_o = dout * tanh_c * og * (1 - og)
+        dc_tot = m * dc_in + dout * og * (1 - tanh_c * tanh_c) + dg_o * wco
+        dg_i = dc_tot * cand * ig * (1 - ig)
+        dg_f = dc_tot * c_prev * fg * (1 - fg)
+        dg_g = dc_tot * ig * (1 - cand * cand)
+        dg = jnp.concatenate([dg_i, dg_f, dg_g, dg_o], axis=-1)
+        dx_ref[:, tt, :] = dg.astype(dx_ref.dtype)
+        dg_scr[:, tt, :] = dg
+        hp_scr[:, tt, :] = h_prev
+        # carries for step t-1
+        dh_scr[:] = (1 - m) * dh_in + lax.dot_general(
+            dg, w, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dc_scr[:] = dc_tot * fg + dg_i * wci + dg_f * wcf + (1 - m) * dc_in
+        # bias + peephole partials for this step
+        db_scr[0, : 4 * h] += jnp.sum(dg, axis=0)
+        db_scr[0, 4 * h : 5 * h] += jnp.sum(dg_i * c_prev, axis=0)
+        db_scr[0, 5 * h : 6 * h] += jnp.sum(dg_f * c_prev, axis=0)
+        db_scr[0, 6 * h : 7 * h] += jnp.sum(dg_o * c_t, axis=0)
         return 0
 
-    lax.fori_loop(0, t_max, body, 0)
+    lax.fori_loop(0, tb, body, 0)
+    # block-level reductions into the resident outputs
+    hp2 = hp_scr[:].reshape(bb * tb, h)
+    dg2 = dg_scr[:].reshape(bb * tb, h4)
+    dw_ref[:] += lax.dot_general(
+        hp2, dg2, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    db_ref[:] += db_scr[:]
 
 
-def _lstm_fwd_kernel(x, w, b7, lens, *, interpret):
-    # Mosaic compiles this kernel for float32; under bf16 AMP upcast in
-    # (the cell math runs float32 internally regardless) and cast the
-    # sequence output back
+def _lstm_plan(bsz, t_max, h):
+    # fwd tokens: x 4h in + (y, c) 2h out, double-buffered
+    tok = 2 * 4 * (4 * h + 2 * h)
+    fixed = 4 * (h * 4 * h + 7 * h) + 8 * 8 * h  # w + b7 + h/c scratch
+    return _plan(bsz, t_max, h, tok, fixed)
+
+
+def _lstm_bwd_plan(bsz, t_max, h):
+    # in: x 4h, y h, yp h, c h, cp h, dy h; out: dx 4h -> 13h tokens,
+    # double-buffered; plus dg/hp scratch 5h tokens (single)
+    tok = 2 * 4 * 13 * h + 4 * 5 * h
+    fixed = 4 * (2 * h * 4 * h + 2 * 7 * h) + 8 * 8 * h
+    return _plan(bsz, t_max, h, tok, fixed, budget=_VMEM_BUDGET_BWD)
+
+
+def _lstm_fwd_pallas(x, w, b7, lens, *, interpret, want_c):
+    """Returns (y, c_seq) — c_seq None unless `want_c` (training path
+    saving the cell sequence for the backward kernel) — or None if
+    infeasible."""
     orig = x.dtype
-    if orig == jnp.bfloat16:
-        x = x.astype(jnp.float32)
-        w = w.astype(jnp.float32)
-        b7 = b7.astype(jnp.float32)
     bsz, t_max, h4 = x.shape
     h = h4 // 4
-    bb = _batch_block(bsz, t_max, h4, h)
-    grid = (bsz // bb,)
-    return pl.pallas_call(
-        _lstm_kernel,
+    plan = _lstm_plan(bsz, t_max, h)
+    if plan is None:
+        return None
+    bb, tb, bp, tp = plan
+    if orig == jnp.bfloat16:
+        x, w, b7 = (a.astype(jnp.float32) for a in (x, w, b7))
+    xp = _pad_bt(x, bp, tp)
+    lensp = jnp.pad(lens, ((0, bp - bsz), (0, 0)))
+    grid = (bp // bb, tp // tb)
+    blk = pl.BlockSpec((bb, tb, h), lambda i, j: (i, j, 0))
+    out = pl.pallas_call(
+        _lstm_fwd_kernel if want_c else _lstm_fwd_kernel_noc,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bb, t_max, h4), lambda i: (i, 0, 0)),
-            pl.BlockSpec((h, h4), lambda i: (0, 0)),
-            pl.BlockSpec((1, 7 * h), lambda i: (0, 0)),
-            pl.BlockSpec((bb, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bb, tb, h4), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((h, h4), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 7 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((bb, 1), lambda i, j: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((bb, t_max, h), lambda i: (i, 0, 0)),
-        # NOTE: a bf16 output ref would halve output HBM traffic, but
-        # the Mosaic toolchain on this TPU fails to compile bf16 stores
-        # from this kernel (remote_compile 500) — so the kernel emits
-        # float32 and XLA converts after. Revisit when Mosaic allows it.
-        out_shape=jax.ShapeDtypeStruct((bsz, t_max, h), x.dtype),
+        out_specs=[blk, blk] if want_c else blk,
+        out_shape=(
+            [
+                jax.ShapeDtypeStruct((bp, tp, h), jnp.float32),
+                jax.ShapeDtypeStruct((bp, tp, h), jnp.float32),
+            ]
+            if want_c
+            else jax.ShapeDtypeStruct((bp, tp, h), jnp.float32)
+        ),
         scratch_shapes=[
             pltpu.VMEM((bb, h), jnp.float32),
             pltpu.VMEM((bb, h), jnp.float32),
         ],
         interpret=interpret,
-    )(x, w, b7, lens).astype(orig)
+    )(xp, w, b7, lensp)
+    if want_c:
+        y, c = out
+        return y[:bsz, :t_max].astype(orig), c[:bsz, :t_max]
+    return out[:bsz, :t_max].astype(orig), None
+
+
+def _lstm_bwd_pallas(x, w, b7, lens, y, c_seq, dy, *, interpret):
+    """Returns (dx, dw, db7) or None if infeasible."""
+    orig = x.dtype
+    bsz, t_max, h4 = x.shape
+    h = h4 // 4
+    plan = _lstm_bwd_plan(bsz, t_max, h)
+    if plan is None:
+        return None
+    bb, tb, bp, tp = plan
+    # measured on v5e: with bb < 32 the per-step [bb,h]@[h,4h] matmul
+    # under-fills the MXU and the kernel loses to the scan-recompute
+    # backward (h=512/bb=16: 19.9ms vs 13.8ms scan; h=256/bb>=32 the
+    # kernel wins 1.56x) — fall back unless the batch block is wide.
+    # interpret mode (CPU tests) keeps the kernel path regardless.
+    if bb < 32 and not interpret:
+        return None
+    f32 = jnp.float32
+    # everything in f32 inside the kernel — including w/b7, matching
+    # the forward's bf16-AMP upcast
+    w = w.astype(f32)
+    b7 = b7.astype(f32)
+    xp = _pad_bt(x.astype(f32), bp, tp)
+    yp_ = _pad_bt(y.astype(f32), bp, tp)
+    cp_ = _pad_bt(c_seq.astype(f32), bp, tp)
+    dyp = _pad_bt(dy.astype(f32), bp, tp)
+    lensp = jnp.pad(lens, ((0, bp - bsz), (0, 0)))
+    nt = tp // tb
+    rev = lambda i, j: (i, nt - 1 - j, 0)  # noqa: E731
+    # previous time block (one earlier in real time); clamped at 0 —
+    # its stale values are masked inside the kernel at t == 0
+    prev = lambda i, j: (i, jnp.maximum(nt - 2 - j, 0), 0)  # noqa: E731
+    grid = (bp // bb, nt)
+    dx, dw, db7 = pl.pallas_call(
+        _lstm_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bb, tb, h4), rev),
+            pl.BlockSpec((h, h4), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 7 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((bb, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bb, tb, h), rev),
+            pl.BlockSpec((bb, tb, h), prev),
+            pl.BlockSpec((bb, tb, h), rev),
+            pl.BlockSpec((bb, tb, h), prev),
+            pl.BlockSpec((bb, tb, h), rev),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, tb, h4), rev),
+            pl.BlockSpec((h, h4), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 7 * h), lambda i, j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, tp, h4), jnp.float32),
+            jax.ShapeDtypeStruct((h, h4), jnp.float32),
+            jax.ShapeDtypeStruct((1, 7 * h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bb, h), f32),
+            pltpu.VMEM((bb, h), f32),
+            pltpu.VMEM((bb, tb, h4), f32),
+            pltpu.VMEM((bb, tb, h), f32),
+            pltpu.VMEM((1, 7 * h), f32),
+        ],
+        interpret=interpret,
+    )(xp, w, b7, lensp, yp_, yp_, cp_, cp_, dyp)
+    return dx[:bsz, :t_max].astype(orig), dw, db7
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(7,))
 def lstm_fused(x, w, gb, wci, wcf, wco, lens, interpret=False):
     b7 = jnp.concatenate([gb, wci, wcf, wco])[None, :]
-    return _lstm_fwd_kernel(
-        x, w, b7, lens[:, None].astype(jnp.int32), interpret=interpret
+    out = _lstm_fwd_pallas(
+        x, w, b7, lens[:, None].astype(jnp.int32), interpret=interpret,
+        want_c=False,
     )
+    if out is None:  # weights too large for VMEM: scan is MXU-bound
+        return lstm_ref(x, w, gb, wci, wcf, wco, lens)
+    return out[0]
 
 
 def _lstm_fused_fwd(x, w, gb, wci, wcf, wco, lens, interpret):
-    y = lstm_fused(x, w, gb, wci, wcf, wco, lens, interpret)
-    return y, (x, w, gb, wci, wcf, wco, lens)
+    b7 = jnp.concatenate([gb, wci, wcf, wco])[None, :]
+    out = _lstm_fwd_pallas(
+        x, w, b7, lens[:, None].astype(jnp.int32), interpret=interpret,
+        want_c=True,
+    )
+    if out is None:
+        y = lstm_ref(x, w, gb, wci, wcf, wco, lens)
+        return y, (x, w, gb, wci, wcf, wco, lens, None, None)
+    y, c_seq = out
+    return y, (x, w, gb, wci, wcf, wco, lens, y, c_seq)
 
 
 def _lstm_fused_bwd(interpret, res, dy):
-    x, w, gb, wci, wcf, wco, lens = res
+    x, w, gb, wci, wcf, wco, lens, y, c_seq = res
+    h = w.shape[0]
+    if y is not None:
+        b7 = jnp.concatenate([gb, wci, wcf, wco])[None, :]
+        out = _lstm_bwd_pallas(
+            x, w, b7, lens[:, None].astype(jnp.int32), y, c_seq, dy,
+            interpret=interpret,
+        )
+        if out is not None:
+            dx, dw, db7 = out
+            dgb = db7[0, : 4 * h].astype(gb.dtype)
+            dwci = db7[0, 4 * h : 5 * h].astype(wci.dtype)
+            dwcf = db7[0, 5 * h : 6 * h].astype(wcf.dtype)
+            dwco = db7[0, 6 * h : 7 * h].astype(wco.dtype)
+            return (dx, dw.astype(w.dtype), dgb, dwci, dwcf, dwco, None)
     _, vjp = jax.vjp(lambda *a: lstm_ref(*a, lens), x, w, gb, wci, wcf, wco)
     return (*vjp(dy), None)
 
@@ -208,14 +501,20 @@ def gru_ref(x, w_g, w_c, b, lens):
 
 
 def _gru_kernel(x_ref, wg_ref, wc_ref, b_ref, lens_ref, y_ref, h_scr):
-    bb, t_max, h3 = x_ref.shape
+    bb, tb, h3 = x_ref.shape
     h = h3 // 3
-    h_scr[:] = jnp.zeros_like(h_scr)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        h_scr[:] = jnp.zeros_like(h_scr)
+
     b = b_ref[0, :]
     lens = lens_ref[:, 0]
+    t0 = j * tb
 
-    def body(t, _):
-        x_t = x_ref[:, t, :] + b
+    def body(tt, _):
+        x_t = x_ref[:, tt, :] + b
         h_prev = h_scr[:]
         xu = x_t[:, :h]
         xr = x_t[:, h : 2 * h]
@@ -232,51 +531,61 @@ def _gru_kernel(x_ref, wg_ref, wc_ref, b_ref, lens_ref, y_ref, h_scr):
             )
         )
         out = u * h_prev + (1 - u) * c
-        m = (t < lens).astype(jnp.float32)[:, None]
+        m = (t0 + tt < lens).astype(jnp.float32)[:, None]
         h_scr[:] = m * out + (1 - m) * h_prev
-        # float32 VMEM state; output ref may be bfloat16 under AMP
-        y_ref[:, t, :] = (out * m).astype(y_ref.dtype)
+        y_ref[:, tt, :] = (out * m).astype(y_ref.dtype)
         return 0
 
-    lax.fori_loop(0, t_max, body, 0)
+    lax.fori_loop(0, tb, body, 0)
+
+
+def _gru_plan(bsz, t_max, h):
+    tok = 2 * 4 * (3 * h + h)  # x in + y out, double-buffered
+    fixed = 4 * (h * 2 * h + h * h + 3 * h) + 4 * 8 * h
+    return _plan(bsz, t_max, h, tok, fixed)
 
 
 def _gru_fwd_kernel(x, w_g, w_c, b, lens, *, interpret):
-    # same bf16-AMP upcast as the LSTM kernel
     orig = x.dtype
-    if orig == jnp.bfloat16:
-        x = x.astype(jnp.float32)
-        w_g = w_g.astype(jnp.float32)
-        w_c = w_c.astype(jnp.float32)
-        b = b.astype(jnp.float32)
     bsz, t_max, h3 = x.shape
     h = h3 // 3
-    bb = _batch_block(bsz, t_max, h3, h)
-    grid = (bsz // bb,)
-    return pl.pallas_call(
+    plan = _gru_plan(bsz, t_max, h)
+    if plan is None:
+        return None
+    bb, tb, bp, tp = plan
+    if orig == jnp.bfloat16:
+        x, w_g, w_c, b = (
+            a.astype(jnp.float32) for a in (x, w_g, w_c, b)
+        )
+    xp = _pad_bt(x, bp, tp)
+    lensp = jnp.pad(lens, ((0, bp - bsz), (0, 0)))
+    grid = (bp // bb, tp // tb)
+    y = pl.pallas_call(
         _gru_kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((bb, t_max, h3), lambda i: (i, 0, 0)),
-            pl.BlockSpec((h, 2 * h), lambda i: (0, 0)),
-            pl.BlockSpec((h, h), lambda i: (0, 0)),
-            pl.BlockSpec((1, 3 * h), lambda i: (0, 0)),
-            pl.BlockSpec((bb, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bb, tb, h3), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((h, 2 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((h, h), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 3 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((bb, 1), lambda i, j: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((bb, t_max, h), lambda i: (i, 0, 0)),
-        # float32 out + convert: see the Mosaic bf16-store note in
-        # _lstm_fwd_kernel
-        out_shape=jax.ShapeDtypeStruct((bsz, t_max, h), x.dtype),
+        out_specs=pl.BlockSpec((bb, tb, h), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, tp, h), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bb, h), jnp.float32)],
         interpret=interpret,
-    )(x, w_g, w_c, b[None, :], lens).astype(orig)
+    )(xp, w_g, w_c, b[None, :], lensp)
+    return y[:bsz, :t_max].astype(orig)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
 def gru_fused(x, w_g, w_c, b, lens, interpret=False):
-    return _gru_fwd_kernel(
+    y = _gru_fwd_kernel(
         x, w_g, w_c, b, lens[:, None].astype(jnp.int32), interpret=interpret
     )
+    if y is None:  # weights too large for VMEM
+        return gru_ref(x, w_g, w_c, b, lens)
+    return y
 
 
 def _gru_fused_fwd(x, w_g, w_c, b, lens, interpret):

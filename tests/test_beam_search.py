@@ -130,3 +130,150 @@ def test_decoder_static_sizes_enable_simple_attention():
     seqs, lens, scores = dec.generate(params, [enc, enc])
     assert seqs.shape == (B, 2, 5)
     assert np.asarray(lens).max() <= 5
+
+
+class TestHostHooks:
+    """Host-side beam control callbacks
+    (RecurrentGradientMachine.h:92-152
+    registerBeamSearchControlCallbacks) via jax.pure_callback, verified
+    against a NumPy reference beam."""
+
+    V, EOS = 5, 1
+
+    def _bigram_decoder(self, hooks=None, beam=3, max_len=6):
+        from paddle_tpu.beam_search import BeamHooks
+
+        def step(word):
+            emb = dsl.embedding(word, size=self.V, vocab_size=self.V,
+                                param=ParameterConf(name="bg_hooks"))
+            return dsl.mixed(self.V, [(emb, "identity")], act="softmax",
+                             bias=False, name="prob")
+
+        return BeamSearchDecoder(step, n_static=0, bos_id=0,
+                                 eos_id=self.EOS, beam_size=beam,
+                                 max_length=max_len, hooks=hooks)
+
+    def _table(self):
+        # two competitive chains: 0->2->3->eos and 0->4->3->eos
+        t = np.full((self.V, self.V), -4.0, np.float32)
+        t[0, 2] = 3.0
+        t[0, 4] = 2.5
+        t[2, 3] = 3.0
+        t[4, 3] = 3.0
+        t[3, self.EOS] = 3.0
+        return t
+
+    def _numpy_beam(self, table, beam, max_len, forbid=None):
+        """Reference beam search in plain NumPy (the
+        test_recurrent_machine_generation.cpp oracle role)."""
+        logits = table - np.log(
+            np.exp(table).sum(axis=1, keepdims=True)
+        )
+        if forbid is not None:
+            logits[:, forbid] = -1e30
+        beams = [([0], 0.0, False)]  # (ids incl bos, score, finished)
+        for _ in range(max_len):
+            cand = []
+            for ids, sc, fin in beams:
+                if fin:
+                    cand.append((ids + [self.EOS], sc, True))
+                    continue
+                for w in range(self.V):
+                    cand.append(
+                        (ids + [w], sc + logits[ids[-1], w],
+                         w == self.EOS)
+                    )
+            cand.sort(key=lambda c: -c[1])
+            beams = cand[:beam]
+            if all(f for _, _, f in beams):
+                break
+        return beams
+
+    def test_adjust_hook_forbids_token_matches_numpy(self):
+        """A host adjust hook banning word 2 must reroute the beam to
+        the 0->4->3->eos chain, exactly as the NumPy reference says."""
+        from paddle_tpu.beam_search import BeamHooks
+
+        calls = []
+
+        def adjust(logp, t):
+            calls.append(t)
+            logp = logp.copy()
+            logp[:, :, 2] = -1e30  # forbid token 2 everywhere
+            return logp
+
+        dec = self._bigram_decoder(BeamHooks(adjust=adjust))
+        table = self._table()
+        seqs, lens, scores = dec.generate(
+            params={"bg_hooks": jnp.asarray(table)}, statics=[],
+            batch_size=1,
+        )
+        seqs, lens = np.asarray(seqs), np.asarray(lens)
+        ref = self._numpy_beam(table, beam=3, max_len=6, forbid=2)
+        want = ref[0][0][1:]  # drop BOS
+        got = seqs[0, 0, : lens[0, 0]].tolist()
+        assert got == want[: len(got)], (got, want)
+        assert 2 not in seqs[0]  # token truly banned
+        assert len(calls) > 0  # host hook actually ran
+        # score parity with the NumPy oracle
+        np.testing.assert_allclose(
+            float(np.asarray(scores)[0, 0]), ref[0][1], atol=1e-4
+        )
+
+    def test_drop_hook_truncates_beam(self):
+        """A host drop hook that kills any beam whose last word is 4:
+        the 0->4->... chain must never survive."""
+        from paddle_tpu.beam_search import BeamHooks
+
+        def drop(words, scores, t):
+            return scores, words == 4
+
+        dec = self._bigram_decoder(BeamHooks(drop=drop))
+        table = self._table()
+        seqs, lens, scores = dec.generate(
+            params={"bg_hooks": jnp.asarray(table)}, statics=[],
+            batch_size=1,
+        )
+        seqs = np.asarray(seqs)
+        scores = np.asarray(scores)
+        # surviving best beam is the 2-chain; any beam containing 4 is
+        # dead (NEG_INF score)
+        assert seqs[0, 0, :3].tolist() == [2, 3, self.EOS]
+        for kk in range(seqs.shape[1]):
+            if 4 in seqs[0, kk, : np.asarray(lens)[0, kk]]:
+                assert scores[0, kk] <= -1e29
+
+    def test_stop_hook_ends_generation(self):
+        """stopBeamSearch: a host stop hook at t==1 caps generation."""
+        from paddle_tpu.beam_search import BeamHooks
+
+        seen = []
+
+        def stop(finished, scores, t):
+            seen.append(t)
+            return t >= 1
+
+        dec = self._bigram_decoder(BeamHooks(stop=stop), max_len=6)
+        table = self._table()
+        seqs, lens, scores = dec.generate(
+            params={"bg_hooks": jnp.asarray(table)}, statics=[],
+            batch_size=1,
+        )
+        # only steps 0 and 1 ran
+        assert max(seen) == 1 and len(seen) == 2
+
+    def test_early_exit_all_finished(self):
+        """With a sharply peaked chain ending at t=3, the while-loop
+        exits early: unwritten trailing steps backtrace as EOS."""
+        dec = self._bigram_decoder(beam=2, max_len=50)
+        table = np.full((self.V, self.V), -8.0, np.float32)
+        table[0, 2] = 8.0
+        table[2, 3] = 8.0
+        table[3, self.EOS] = 8.0
+        seqs, lens, scores = dec.generate(
+            params={"bg_hooks": jnp.asarray(table)}, statics=[],
+            batch_size=1,
+        )
+        seqs, lens = np.asarray(seqs), np.asarray(lens)
+        assert seqs[0, 0, :3].tolist() == [2, 3, self.EOS]
+        assert lens[0, 0] == 3
