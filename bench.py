@@ -73,9 +73,13 @@ def _setup():
     jax.config.update("jax_default_prng_impl", "rbg")
 
 
-def _time_train(conf, feed, opt_conf=None, iters=20, warmup=20):
+def _time_train(conf, feed, opt_conf=None, iters=20, warmup=20,
+                windows=3):
     """Build a Network + optimizer from `conf`, run `warmup` steps, then
-    time `iters` steps of the jitted train program. Returns ms/step."""
+    time `windows` windows of `iters` steps and return the BEST
+    window's ms/step — the chip behind the axon tunnel is occasionally
+    preempted, and the minimum window is the robust estimate of
+    steady-state step time (mean would blend in preemption stalls)."""
     import jax
 
     from paddle_tpu.core.config import OptimizationConf
@@ -106,13 +110,16 @@ def _time_train(conf, feed, opt_conf=None, iters=20, warmup=20):
     # float() fetch forces execution; on the axon tunnel
     # block_until_ready does not force the dependency chain
     float(loss)
-    t0 = time.perf_counter()
-    for j in range(iters):
-        params, opt_state, state, loss, _ = step(
-            params, opt_state, state, feed, warmup + j, key
-        )
-    float(loss)
-    return (time.perf_counter() - t0) / iters * 1e3
+    best = float("inf")
+    for w in range(windows):
+        t0 = time.perf_counter()
+        for j in range(iters):
+            params, opt_state, state, loss, _ = step(
+                params, opt_state, state, feed, warmup + j, key
+            )
+        float(loss)
+        best = min(best, (time.perf_counter() - t0) / iters * 1e3)
+    return best
 
 
 def _image_feed(bs, shape=(224, 224, 3), classes=1000, seed=0):
@@ -214,6 +221,60 @@ def bench_lstm_fused_vs_scan(bs=128, hidden=512):
     }
 
 
+def bench_sparse_ctr():
+    """Large-model sparse update (the CTR workload,
+    large_model_dist_train.md): one train-style step over an embedding
+    table — gather touched rows, momentum update, scatter back
+    (parallel/sparse.py::sparse_apply). Measured at 1M and 4M rows x 64:
+    value = time(4M)/time(1M). O(touched) gives ~1.0; an O(V) dense
+    update would give ~4. vs_baseline = 4/value (>1 beats O(V))."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.sparse import sparse_apply
+
+    D, N = 64, 1024
+
+    def step(param, mom, ids, grads):
+        def upd(p, g, m):
+            m2 = 0.9 * m + g
+            return p - 0.01 * m2, m2
+
+        newp, (newm,) = sparse_apply(upd, param, ids, grads, state=(mom,))
+        return newp, newm
+
+    f = jax.jit(step, donate_argnums=(0, 1))
+    rng = np.random.default_rng(0)
+    times = {}
+    for v in (1 << 20, 1 << 22):
+        param = jnp.zeros((v, D), jnp.float32)
+        mom = jnp.zeros((v, D), jnp.float32)
+        ids = jnp.asarray(rng.integers(0, v, N), jnp.int32)
+        grads = jnp.asarray(
+            rng.standard_normal((N, D)), jnp.float32
+        )
+        for _ in range(10):
+            param, mom = f(param, mom, ids, grads)
+        float(jnp.sum(param[0]))
+        best = float("inf")
+        for w in range(3):
+            t0 = time.perf_counter()
+            for _ in range(30):
+                param, mom = f(param, mom, ids, grads)
+            float(jnp.sum(param[0]))
+            best = min(best, (time.perf_counter() - t0) / 30 * 1e3)
+        times[v] = best
+    ratio = times[1 << 22] / times[1 << 20]
+    return {
+        "value": round(ratio, 3),
+        "unit": "time(4M rows)/time(1M rows)",
+        "ms_1m": round(times[1 << 20], 4),
+        "ms_4m": round(times[1 << 22], 4),
+        "table_dim": D,
+        "touched": N,
+    }
+
+
 def bench_resnet50(bs=256):
     from paddle_tpu.models import resnet
 
@@ -284,6 +345,7 @@ def build_sweep():
             )
     sweep.append(("lstm_train_fused_speedup_vs_scan",
                   bench_lstm_fused_vs_scan))
+    sweep.append(("ctr_sparse_step_v_independence", bench_sparse_ctr))
     sweep.append(("resnet50_train_imgs_per_s", bench_resnet50))
     sweep.append(("nmt_attention_train_tokens_per_s", bench_nmt))
     return sweep
@@ -311,6 +373,9 @@ def main(argv):
             elif name.startswith("nmt"):
                 line["vs_baseline"] = round(line["value"] / R1_NMT_TOK_S, 2)
                 line["baseline"] = "round-1 measured 90k tok/s/chip"
+            elif name.startswith("ctr_sparse"):
+                line["vs_baseline"] = round(4.0 / max(line["value"], 1e-9), 2)
+                line["baseline"] = "O(V) dense update would be ~4.0"
         except Exception as e:  # keep sweeping; record the failure
             failures += 1
             line["error"] = f"{type(e).__name__}: {e}"[:300]

@@ -20,6 +20,7 @@ from paddle_tpu.parallel.sharding import (  # noqa: F401
 )
 from paddle_tpu.parallel.sparse import (  # noqa: F401
     apply_rows,
+    sparse_apply,
     embedding_lookup,
     touched_rows,
 )

@@ -64,9 +64,69 @@ def touched_rows(ids, vocab_size: int):
         .set(True)
     )
 
+
 def apply_rows(update_fn, param, grad, touched):
-    """Apply `update_fn(param_rows, grad_rows) -> new_rows` only to touched
-    rows, leaving the rest bit-identical — the sparse_update optimizer
-    contract (ParameterOptimizer needSpecialTraversal / catchUpWith)."""
+    """DENSE reference implementation: apply `update_fn(param_rows,
+    grad_rows) -> new_rows` to touched rows, leaving the rest
+    bit-identical — the sparse_update optimizer contract
+    (ParameterOptimizer needSpecialTraversal / catchUpWith). O(V) — the
+    parity oracle for `sparse_apply`, which is the production path."""
     new = update_fn(param, grad)
     return jnp.where(touched[:, None], new, param)
+
+
+def sparse_apply(update_fn, param, ids, grads, state=(), num_slots=None):
+    """Gather-touched -> update -> scatter: step cost independent of V.
+
+    The reference's large-model update rule (math/SparseRowMatrix.h:204
+    SparsePrefetchRowCpuMatrix + trainer/RemoteParameterUpdater.h:265
+    SparseRemoteParameterUpdater; design
+    doc/design/cluster_train/large_model_dist_train.md): only the rows a
+    batch touches are pulled, optimized, and written back.
+
+    param: [V, D]. ids: int [N] (token occurrences, duplicates fine).
+    grads: [N, D] per-occurrence gradients (the row-sparse cotangent of
+    the lookups). state: tuple of [V, ...] optimizer-state tensors
+    sliced/written alongside param (momentum, adagrad accumulators...).
+    update_fn(param_rows, grad_rows, *state_rows) ->
+    (new_rows, *new_state_rows) — or just new_rows when state is empty.
+    num_slots: static unique-row capacity (default N).
+
+    Returns (new_param, new_state) (new_state a tuple like `state`).
+    All compute is O(num_slots * D): ids are unique'd (sorted, static
+    size), per-occurrence grads segment-summed into their slot, rows
+    gathered once, updated, and scattered back as deltas."""
+    ids = ids.reshape(-1).astype(jnp.int32)
+    n = ids.shape[0]
+    k = num_slots or n
+    uids, inv = jnp.unique(
+        ids, size=k, fill_value=-1, return_inverse=True
+    )
+    valid = uids >= 0
+    safe = jnp.where(valid, uids, 0)
+    gsum = (
+        jnp.zeros((k,) + grads.shape[1:], grads.dtype)
+        .at[inv.reshape(-1)]
+        .add(grads.reshape((n,) + grads.shape[1:]))
+    )
+    prows = param[safe]
+    srows = tuple(s[safe] for s in state)
+    out = update_fn(prows, gsum, *srows)
+    if state:
+        new_rows, *new_srows = out
+    else:
+        new_rows, new_srows = out, []
+    # scatter as masked DELTAS: invalid slots all alias row 0, and
+    # adding zero there is order-independent (a .set with duplicate
+    # indices would not be)
+    vmask = valid[:, None].astype(param.dtype)
+    new_param = param.at[safe].add((new_rows - prows) * vmask)
+    new_state = tuple(
+        s.at[safe].add(
+            (ns - sr) * valid.reshape((k,) + (1,) * (ns.ndim - 1)).astype(
+                s.dtype
+            )
+        )
+        for s, sr, ns in zip(state, srows, new_srows)
+    )
+    return new_param, new_state
