@@ -121,6 +121,66 @@ def load_merged(path: str):
     return conf, tree.get("params", {}), tree.get("state", {})
 
 
+# --- multi-host sharded checkpoints -----------------------------------
+#
+# Every process saves ITS addressable shards and restores them on
+# restart — the Go pserver's per-shard checkpoint/recover intent
+# (go/pserver/service.go:76-126: each pserver checkpoints its own
+# parameter shard; recovery reassembles the global state).
+
+
+def _walk_arrays(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_walk_arrays(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def save_sharded(save_dir: str, tree, tag: str = "ckpt") -> str:
+    """Write this process's addressable shards of a (possibly globally
+    sharded) pytree. Call from EVERY process; each writes
+    `{tag}.p{process_index}.npz` keyed `<name>##<device_id>`."""
+    os.makedirs(save_dir, exist_ok=True)
+    payload = {}
+    for name, arr in _walk_arrays(tree).items():
+        arr = jax.numpy.asarray(arr) if not hasattr(
+            arr, "addressable_shards"
+        ) else arr
+        for sh in arr.addressable_shards:
+            payload[f"{name}##{sh.device.id}"] = np.asarray(sh.data)
+    path = os.path.join(
+        save_dir, f"{tag}.p{jax.process_index()}.npz"
+    )
+    np.savez(path[:-4] + ".tmp", **payload)  # savez appends .npz
+    os.replace(path[:-4] + ".tmp.npz", path)
+    return path
+
+
+def load_sharded(save_dir: str, template, tag: str = "ckpt"):
+    """Restore this process's shards written by `save_sharded` and
+    reassemble global arrays. `template` is a pytree of arrays (or
+    ShapeDtypeStructs) carrying the target global shape + sharding."""
+    path = os.path.join(
+        save_dir, f"{tag}.p{jax.process_index()}.npz"
+    )
+    flat_t = _walk_arrays(template)
+    out_flat = {}
+    with np.load(path) as z:
+        for name, t in flat_t.items():
+            sharding = t.sharding
+            bufs = [
+                jax.device_put(z[f"{name}##{d.id}"], d)
+                for d in sharding.addressable_devices
+            ]
+            out_flat[name] = jax.make_array_from_single_device_arrays(
+                t.shape, sharding, bufs
+            )
+    return _unflatten(out_flat)
+
+
 # --- v2 tar checkpoint format, wire-compatible with the reference ---
 #
 # parameters.py:280-302 serialize/deserialize: each parameter tar member is
