@@ -168,6 +168,30 @@ def cmd_master(args):
     return 0
 
 
+def cmd_make_diagram(args):
+    """Emit a graphviz .dot of the layer graph (the reference's
+    `paddle make_diagram`, scripts/submit_local.sh.in:3-13)."""
+    from paddle_tpu.plot import make_diagram
+
+    with open(args.config) as f:
+        src = f.read()
+    if "def get_config" in src:
+        mod = _load_config(args.config)
+        model_conf, _ = mod.get_config()
+    else:
+        # an unmodified v1 config file (settings()/outputs() style)
+        from paddle_tpu.compat.config_parser import parse_config
+
+        model_conf = parse_config(args.config, args.config_args).model
+    dot = make_diagram(model_conf, title=args.config)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dot)
+    else:
+        print(dot, end="")
+    return 0
+
+
 def cmd_bench(args):
     import runpy
 
@@ -212,6 +236,12 @@ def main(argv=None):
     sp.add_argument("--failure_max", type=int, default=3)
     sp.add_argument("--snapshot", default="")
     sp.set_defaults(fn=cmd_master)
+
+    sp = sub.add_parser("make_diagram", help="emit graphviz dot of a config")
+    sp.add_argument("--config", required=True)
+    sp.add_argument("--config_args", default="")
+    sp.add_argument("--output", default="")
+    sp.set_defaults(fn=cmd_make_diagram)
 
     sp = sub.add_parser("bench", help="run the benchmark harness")
     sp.add_argument("--script", default="bench.py")

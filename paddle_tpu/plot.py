@@ -89,3 +89,40 @@ class Ploter:
     def reset(self):
         for d in self.__plot_data__.values():
             d.reset()
+
+
+def make_diagram(model_conf, title: str = "model") -> str:
+    """Graphviz dot text for a ModelConf's layer graph — the `paddle
+    make_diagram` subcommand (paddle/scripts/submit_local.sh.in:3-13 →
+    python/paddle/utils/make_model_diagram.py). Pure text, no graphviz
+    dependency: render with `dot -Tpng model.dot -o model.png`."""
+    shapes = {"data": "box", "mixed": "hexagon"}
+    lines = [
+        f'digraph "{title}" {{',
+        "  rankdir=TB;",
+        '  node [fontsize=10, shape=ellipse, style=filled,'
+        ' fillcolor="#e8eef7"];',
+    ]
+
+    def q(name):
+        return '"' + name.replace('"', "'") + '"'
+
+    for lc in model_conf.layers:
+        shape = shapes.get(lc.type, "ellipse")
+        fill = "#f7e8e8" if "cost" in lc.type or lc.type in (
+            "classification_cost", "cross_entropy", "mse_cost",
+        ) else ("#e8f7ea" if lc.type == "data" else "#e8eef7")
+        label = f"{lc.name}\\n{lc.type}"
+        if lc.size:
+            label += f" [{lc.size}]"
+        lines.append(
+            f"  {q(lc.name)} [label=\"{label}\", shape={shape},"
+            f" fillcolor=\"{fill}\"];"
+        )
+    for lc in model_conf.layers:
+        for src in lc.input_names():
+            lines.append(f"  {q(src)} -> {q(lc.name)};")
+    for out in model_conf.output_layer_names:
+        lines.append(f"  {q(out)} [penwidth=2];")
+    lines.append("}")
+    return "\n".join(lines) + "\n"

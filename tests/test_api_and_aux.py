@@ -289,3 +289,26 @@ class TestPrngFlag:
         finally:
             F.set_flag("prng_impl", None)
             jax.config.update("jax_default_prng_impl", "threefry2x32")
+
+
+REPO_ROOT = __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))
+)
+
+
+def test_make_diagram_cli():
+    """`paddle make_diagram` (scripts/submit_local.sh.in:3-13) emits
+    graphviz dot for an UNMODIFIED reference v1 config."""
+    import subprocess
+    import sys
+
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "make_diagram",
+         "--config", "/root/reference/benchmark/paddle/image/alexnet.py",
+         "--config_args", "batch_size=8"],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    dot = out.stdout
+    assert dot.startswith("digraph")
+    assert '"data"' in dot and "exconv" in dot and "-> \"cost\"" in dot

@@ -1,0 +1,99 @@
+"""ResNet-50 train-step profile: timings, XLA cost analysis, and an
+XPlane trace (core/profiler.py) — the evidence behind PERF.md.
+
+Usage: python tools/profile_resnet.py [--trace-dir /tmp/rn50-trace]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=256)
+    ap.add_argument("--trace-dir", default="")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.core import flags as _flags
+
+    _flags.set_flag("matmul_precision", "bfloat16")
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+    from paddle_tpu.core.arg import id_arg, non_seq
+    from paddle_tpu.models import resnet
+    from paddle_tpu.network import Network
+
+    bs = args.bs
+    conf = resnet(depth=50, image_shape=(224, 224, 3), num_classes=1000)
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    state = net.init_state()
+    rng = np.random.default_rng(0)
+    feed = jax.device_put({
+        "image": non_seq(
+            rng.standard_normal((bs, 224, 224, 3)).astype(np.float32)
+        ),
+        "label": id_arg(rng.integers(0, 1000, bs).astype(np.int32)),
+    })
+    key = jax.random.key(1)
+
+    def loss(p, f):
+        return net.loss_fn(p, f, state=state, rng=key, train=True)[0]
+
+    gf = jax.jit(lambda p, f: jax.grad(loss)(p, f))
+    c = gf.lower(params, feed).compile()
+    ca = c.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    ma = c.memory_analysis()
+
+    def bench(f, *a, n=10):
+        for _ in range(5):
+            r = f(*a)
+        float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = f(*a)
+            float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best * 1e3
+
+    ms = bench(gf, params, feed)
+    report = {
+        "batch_size": bs,
+        "fwd_bwd_ms": round(ms, 2),
+        "xla_flops": ca.get("flops", 0),
+        "xla_bytes_accessed": ca.get("bytes accessed", 0),
+        "hbm_temp_bytes": ma.temp_size_in_bytes,
+        "img_per_s": round(bs / ms * 1e3, 1),
+        "mfu_at_24p6_gflop_img": round(
+            bs / ms * 1e3 * 24.6e9 / 197e12, 4
+        ),
+    }
+    print(json.dumps(report, indent=2))
+
+    if args.trace_dir:
+        from paddle_tpu.core import profiler
+
+        with profiler.trace(args.trace_dir):
+            for _ in range(3):
+                r = gf(params, feed)
+            float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        print(f"trace written to {args.trace_dir}")
+
+
+if __name__ == "__main__":
+    main()
