@@ -545,6 +545,181 @@ def _gru_plan(bsz, t_max, h):
     return _plan(bsz, t_max, h, tok, fixed)
 
 
+def _gru_bwd_kernel(
+    x_ref, wg_ref, wc_ref, b_ref, lens_ref, y_ref, yp_ref, dy_ref,
+    dx_ref, dwg_ref, dwc_ref, db_ref,
+    dh_scr, dgg_scr, dgc_scr, hp_scr, rh_scr, db_scr,
+):
+    """Reverse-time GRU backward (mirrors _lstm_bwd_kernel): gates
+    recomputed from x and the saved output sequence (h_{t-1} = y[t-1]
+    wherever the mask is live, previous block's last row at the
+    boundary), dW_g/dW_c/db accumulated in resident output blocks."""
+    bb, tb, h3 = x_ref.shape
+    h = h3 // 3
+    i_blk = pl.program_id(0)
+    j = pl.program_id(1)
+    nt = pl.num_programs(1)
+    k = nt - 1 - j
+    t0 = k * tb
+
+    @pl.when(j == 0)
+    def _init_carry():
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+
+    @pl.when((i_blk == 0) & (j == 0))
+    def _init_outs():
+        dwg_ref[:] = jnp.zeros_like(dwg_ref)
+        dwc_ref[:] = jnp.zeros_like(dwc_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
+    db_scr[:] = jnp.zeros_like(db_scr)
+    b = b_ref[0, :]
+    lens = lens_ref[:, 0]
+    w_g = wg_ref[:]
+    w_c = wc_ref[:]
+
+    def body(s, _):
+        tt = tb - 1 - s
+        t = t0 + tt
+        m = (t < lens).astype(jnp.float32)[:, None]
+        tt_prev = jnp.maximum(tt - 1, 0)
+        in_blk = (tt > 0).astype(jnp.float32)
+        live = jnp.where(t == 0, 0.0, 1.0)
+        h_prev = live * (
+            in_blk * y_ref[:, tt_prev, :]
+            + (1 - in_blk) * yp_ref[:, tb - 1, :]
+        )
+        # recompute the forward gates
+        xb = x_ref[:, tt, :] + b
+        gur = jnp.dot(h_prev, w_g, preferred_element_type=jnp.float32)
+        u = jax.nn.sigmoid(xb[:, :h] + gur[:, :h])
+        r = jax.nn.sigmoid(xb[:, h : 2 * h] + gur[:, h:])
+        rh = r * h_prev
+        c = jnp.tanh(
+            xb[:, 2 * h :]
+            + jnp.dot(rh, w_c, preferred_element_type=jnp.float32)
+        )
+        # backward through the step
+        dh_in = dh_scr[:]
+        dout = m * (dh_in + dy_ref[:, tt, :])
+        du = dout * (h_prev - c)
+        dc = dout * (1 - u)
+        dg_c = dc * (1 - c * c)
+        drh = lax.dot_general(
+            dg_c, w_c, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dr = drh * h_prev
+        dg_u = du * u * (1 - u)
+        dg_r = dr * r * (1 - r)
+        dg_ur = jnp.concatenate([dg_u, dg_r], axis=-1)
+        dh_prev = (
+            (1 - m) * dh_in
+            + drh * r
+            + dout * u
+            + lax.dot_general(
+                dg_ur, w_g, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        dx = jnp.concatenate([dg_ur, dg_c], axis=-1)
+        dx_ref[:, tt, :] = dx.astype(dx_ref.dtype)
+        dgg_scr[:, tt, :] = dg_ur
+        dgc_scr[:, tt, :] = dg_c
+        hp_scr[:, tt, :] = h_prev
+        rh_scr[:, tt, :] = rh
+        dh_scr[:] = dh_prev
+        db_scr[0, :] += jnp.sum(dx, axis=0)
+        return 0
+
+    lax.fori_loop(0, tb, body, 0)
+    hp2 = hp_scr[:].reshape(bb * tb, h)
+    rh2 = rh_scr[:].reshape(bb * tb, h)
+    dwg_ref[:] += lax.dot_general(
+        hp2, dgg_scr[:].reshape(bb * tb, 2 * h),
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    dwc_ref[:] += lax.dot_general(
+        rh2, dgc_scr[:].reshape(bb * tb, h),
+        (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    db_ref[:] += db_scr[:]
+
+
+def _gru_bwd_plan(bsz, t_max, h):
+    # in: x 3h, y h, yp h, dy h; out dx 3h -> 9h tokens double-buffered;
+    # scratch dgg 2h + dgc h + hp h + rh h = 5h tokens (single)
+    tok = 2 * 4 * 9 * h + 4 * 5 * h
+    fixed = 4 * (2 * (h * 2 * h + h * h) + 2 * 3 * h) + 4 * 8 * h
+    return _plan(bsz, t_max, h, tok, fixed, budget=_VMEM_BUDGET_BWD)
+
+
+def _gru_bwd_pallas(x, w_g, w_c, b, lens, y, dy, *, interpret):
+    orig = x.dtype
+    bsz, t_max, h3 = x.shape
+    h = h3 // 3
+    plan = _gru_bwd_plan(bsz, t_max, h)
+    if plan is None:
+        return None
+    bb, tb, bp, tp = plan
+    # same MXU-fill gate as the LSTM backward (measured on v5e)
+    if bb < 32 and not interpret:
+        return None
+    f32 = jnp.float32
+    w_g = w_g.astype(f32)
+    w_c = w_c.astype(f32)
+    b2 = b.astype(f32)[None, :]
+    xp = _pad_bt(x.astype(f32), bp, tp)
+    yp_ = _pad_bt(y.astype(f32), bp, tp)
+    dyp = _pad_bt(dy.astype(f32), bp, tp)
+    lensp = jnp.pad(lens, ((0, bp - bsz), (0, 0)))
+    nt = tp // tb
+    rev = lambda i, j: (i, nt - 1 - j, 0)  # noqa: E731
+    prev = lambda i, j: (i, jnp.maximum(nt - 2 - j, 0), 0)  # noqa: E731
+    grid = (bp // bb, nt)
+    dx, dwg, dwc, db3 = pl.pallas_call(
+        _gru_bwd_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bb, tb, h3), rev),
+            pl.BlockSpec((h, 2 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((h, h), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 3 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((bb, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((bb, tb, h), rev),
+            pl.BlockSpec((bb, tb, h), prev),
+            pl.BlockSpec((bb, tb, h), rev),
+        ],
+        out_specs=[
+            pl.BlockSpec((bb, tb, h3), rev),
+            pl.BlockSpec((h, 2 * h), lambda i, j: (0, 0)),
+            pl.BlockSpec((h, h), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 3 * h), lambda i, j: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, tp, h3), f32),
+            jax.ShapeDtypeStruct((h, 2 * h), f32),
+            jax.ShapeDtypeStruct((h, h), f32),
+            jax.ShapeDtypeStruct((1, 3 * h), f32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bb, h), f32),
+            pltpu.VMEM((bb, tb, 2 * h), f32),
+            pltpu.VMEM((bb, tb, h), f32),
+            pltpu.VMEM((bb, tb, h), f32),
+            pltpu.VMEM((bb, tb, h), f32),
+            pltpu.VMEM((1, 3 * h), f32),
+        ],
+        interpret=interpret,
+    )(xp, w_g, w_c, b2, lensp, yp_, yp_, dyp)
+    return (
+        dx[:bsz, :t_max].astype(orig),
+        dwg.astype(w_g.dtype),
+        dwc.astype(w_c.dtype),
+        db3[0],
+    )
+
+
 def _gru_fwd_kernel(x, w_g, w_c, b, lens, *, interpret):
     orig = x.dtype
     bsz, t_max, h3 = x.shape
@@ -590,11 +765,21 @@ def gru_fused(x, w_g, w_c, b, lens, interpret=False):
 
 def _gru_fused_fwd(x, w_g, w_c, b, lens, interpret):
     y = gru_fused(x, w_g, w_c, b, lens, interpret)
-    return y, (x, w_g, w_c, b, lens)
+    plan = _gru_plan(x.shape[0], x.shape[1], w_c.shape[0])
+    # y came from the kernel only if the fwd plan was feasible
+    return y, (x, w_g, w_c, b, lens, y if plan is not None else None)
 
 
 def _gru_fused_bwd(interpret, res, dy):
-    x, w_g, w_c, b, lens = res
+    x, w_g, w_c, b, lens, y = res
+    if y is not None:
+        out = _gru_bwd_pallas(
+            x, w_g, w_c, b, lens[:, None].astype(jnp.int32), y, dy,
+            interpret=interpret,
+        )
+        if out is not None:
+            dx, dwg, dwc, db3 = out
+            return (dx, dwg, dwc, db3.astype(b.dtype), None)
     _, vjp = jax.vjp(lambda *a: gru_ref(*a, lens), x, w_g, w_c, b)
     return (*vjp(dy), None)
 

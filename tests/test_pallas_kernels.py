@@ -220,3 +220,41 @@ class TestLstmBwdKernelBlocked:
             lambda x: jnp.sum(pr.lstm_fused(x, w, z, p, p, p, lens, True))
         )(x)
         assert np.isfinite(np.asarray(g)).all()
+
+
+class TestGruBwdKernelBlocked:
+    """Reverse-time GRU backward kernel across block boundaries (same
+    discipline as TestLstmBwdKernelBlocked)."""
+
+    def test_all_grads_match_scan_multiblock(self, monkeypatch):
+        import paddle_tpu.ops.pallas_rnn as pr
+
+        B, T, h = 11, 21, 8
+        monkeypatch.setattr(pr, "_VMEM_BUDGET", 80_000)
+        monkeypatch.setattr(pr, "_VMEM_BUDGET_BWD", 80_000)
+        plan = pr._gru_bwd_plan(B, T, h)
+        assert plan is not None
+        bb, tb, bp, tp = plan
+        assert bp // bb > 1 and tp // tb > 1  # real block boundaries
+
+        ks = jax.random.split(jax.random.key(3), 4)
+        x = jax.random.normal(ks[0], (B, T, 3 * h))
+        w_g = jax.random.normal(ks[1], (h, 2 * h)) * 0.3
+        w_c = jax.random.normal(ks[2], (h, h)) * 0.3
+        b = jax.random.normal(ks[3], (3 * h,)) * 0.1
+        lens = jnp.asarray(
+            np.random.default_rng(5).integers(0, T + 1, B), jnp.int32
+        )
+
+        gk = jax.grad(
+            lambda *a: jnp.sum(pr.gru_fused(*a, lens, True) ** 2),
+            argnums=(0, 1, 2, 3),
+        )(x, w_g, w_c, b)
+        gr = jax.grad(
+            lambda *a: jnp.sum(pr.gru_ref(*a, lens) ** 2),
+            argnums=(0, 1, 2, 3),
+        )(x, w_g, w_c, b)
+        for n, a, bb_ in zip(["dx", "dwg", "dwc", "db"], gk, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(bb_), atol=2e-4, err_msg=n
+            )
