@@ -192,7 +192,18 @@ class Network:
                     )
                     for k, v in layer_params.items()
                 }
-            outs[name] = layer.forward(layer_params, inputs, ctx)
+            try:
+                with jax.named_scope(f"{lc.type}:{name}"):
+                    outs[name] = layer.forward(layer_params, inputs, ctx)
+            except Exception as e:
+                # the layer-stack-on-crash context of the reference's
+                # CustomStackTrace (utils/CustomStackTrace.h:51, pushed
+                # per layer in NeuralNetwork.cpp:249-251)
+                e.add_note(
+                    f"  while running layer {name!r} "
+                    f"(type={lc.type!r}, inputs={lc.input_names()})"
+                )
+                raise
             spec = lc.attrs.get("out_sharding")
             if spec is not None:
                 # Per-layer placement hint — the GSPMD replacement for the

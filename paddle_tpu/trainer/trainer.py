@@ -13,6 +13,7 @@ import logging
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.core import flags as _flags
@@ -123,6 +124,21 @@ class SGD:
     def _make_evaluators(self):
         return [create_evaluator(c) for c in self.evaluator_confs]
 
+    def _log_parameter_stats(self, pass_id: int, batch_id: int) -> None:
+        """Per-parameter value statistics every
+        --show_parameter_stats_period batches (the reference's
+        TrainerInternal.cpp:80-90 avg/max-abs dump; grads are
+        step-internal here, so value stats are the observable)."""
+        for name in sorted(self.params):
+            v = self.params[name]
+            log.info(
+                "param stats pass %d batch %d %s: shape=%s "
+                "avg_abs=%.6f max_abs=%.6f",
+                pass_id, batch_id, name, tuple(v.shape),
+                float(jnp.mean(jnp.abs(v))),
+                float(jnp.max(jnp.abs(v))),
+            )
+
     def train(
         self,
         reader: Callable,
@@ -181,6 +197,11 @@ class SGD:
                         float(np.mean(costs[-log_period:])),
                         results,
                     )
+                stats_period = _flags.get_flag(
+                    "show_parameter_stats_period"
+                )
+                if stats_period and (batch_id + 1) % stats_period == 0:
+                    self._log_parameter_stats(pass_id, batch_id)
             results = {ev.name: ev.result() for ev in evals}
             if test_reader is not None:
                 tr = self.test(test_reader, feeder)

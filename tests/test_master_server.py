@@ -22,11 +22,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # Worker process: lease tasks, append each ack'd payload to OUT_FILE.
 # If HANG_AT is set, hang forever (without acking) upon leasing that
 # payload — the parent then SIGKILLs us, simulating a trainer crash
-# mid-task.
+# mid-task. master_client.py is loaded by file path: it only needs
+# socket/struct, and importing the paddle_tpu package would pay a jax
+# import per worker process.
 WORKER_SRC = """
-import json, os, sys, time
-sys.path.insert(0, os.environ["REPO"])
-from paddle_tpu.data.master_client import MasterClient
+import importlib.util, json, os, sys, time
+spec = importlib.util.spec_from_file_location(
+    "mc", os.environ["REPO"] + "/paddle_tpu/data/master_client.py")
+mc = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mc)
+MasterClient = mc.MasterClient
 
 c = MasterClient(os.environ["ADDR"])
 hang_at = os.environ.get("HANG_AT")

@@ -77,12 +77,12 @@ class TestReferenceBenchmarkConfigs:
         batch = []
         for sample in reader():
             batch.append(sample)
-            if len(batch) == 4:
+            if len(batch) == 2:
                 break
         feed = feeder(batch)
-        assert feed["data"].value.shape == (4, 227 * 227 * 3)
+        assert feed["data"].value.shape == (2, 227 * 227 * 3)
 
-        losses, _, _ = _train_steps(tc, feed, steps=2)
+        losses, _, _ = _train_steps(tc, feed, steps=1)
         assert np.isfinite(losses).all()
         # 1000-way CE starts near ln(1000)
         assert 2.0 < losses[0] < 14.0
@@ -171,6 +171,35 @@ class TestQuickStartConfigs:
         losses, _, _ = _train_steps(tc, feed, steps=6)
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]  # 2-class LR learns immediately
+
+    def test_quick_start_resnet_lstm_trains(self, tmp_path, monkeypatch):
+        """trainer_config.resnet-lstm.py (the GNMT-style residual
+        stacked LSTM demo) UNMODIFIED: 4 stacked LSTMs with residual
+        addto links, dropout cell attrs, max pooling — parses, builds,
+        and fits a tiny batch via the reference's dataprovider_emb."""
+        self._setup_quick_start_data(tmp_path)
+        monkeypatch.chdir(tmp_path)
+        tc = parse_config(
+            f"{REF}/v1_api_demo/quick_start/trainer_config.resnet-lstm.py"
+        )
+        types_ = [l.type for l in tc.model.layers]
+        assert types_.count("lstmemory") == 4
+        assert types_.count("addto") >= 3  # residual links (+dropout)
+
+        mod = load_provider_module(
+            "dataprovider_emb", tc.data_sources.search_dir
+        )
+        provider = getattr(mod, tc.data_sources.obj)
+        reader = provider(
+            [str(tmp_path / "data" / "train.txt")],
+            **tc.data_sources.args,
+        )
+        types = provider.input_types
+        feeder = DataFeeder({n: n for n in types}, types)
+        feed = feeder(list(reader()))
+        losses, _, _ = _train_steps(tc, feed, steps=4)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
 
     def test_quick_start_lstm_config_parses(self, tmp_path, monkeypatch):
         """trainer_config.lstm.py: embedding + simple_lstm with dropout

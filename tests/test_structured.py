@@ -46,12 +46,15 @@ def test_crf_loglik_is_normalized():
     n, t = 3, 4
     emit = jnp.asarray(rng.standard_normal((1, t, n)), jnp.float32)
     w = jnp.asarray(rng.standard_normal((n + 2, n)), jnp.float32)
-    lens = jnp.asarray([t], jnp.int32)
-    total = 0.0
-    for path in itertools.product(range(n), repeat=t):
-        lab = jnp.asarray([list(path)], jnp.int32)
-        ll = crf_ops.crf_log_likelihood(emit, lab, lens, w)
-        total += float(jnp.exp(ll[0]))
+    paths = np.asarray(
+        list(itertools.product(range(n), repeat=t)), np.int32
+    )  # [n^t, t] — ALL label sequences in one batched call
+    emit_b = jnp.broadcast_to(emit, (len(paths), t, n))
+    lens_b = jnp.full((len(paths),), t, jnp.int32)
+    ll = crf_ops.crf_log_likelihood(
+        emit_b, jnp.asarray(paths), lens_b, w
+    )
+    total = float(jnp.sum(jnp.exp(ll)))
     assert abs(total - 1.0) < 1e-4
 
 
