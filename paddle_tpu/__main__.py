@@ -66,13 +66,24 @@ def cmd_train(args):
     from paddle_tpu.trainer import SGD
     from paddle_tpu.trainer import events
 
-    mod = _load_config(args.config)
-    model_conf, opt_conf = mod.get_config()
+    with open(args.config) as f:
+        is_v1 = "def get_config" not in f.read()
+    if is_v1:
+        # UNMODIFIED reference v1 config: the `paddle train --config X
+        # --config_args Y` path (trainer/TrainerMain.cpp:32 +
+        # config_parser.py:3724) — model + optimizer + data provider
+        # all come from the config file itself
+        model_conf, opt_conf, reader, feeder = _v1_train_setup(
+            args.config, args.config_args
+        )
+    else:
+        mod = _load_config(args.config)
+        model_conf, opt_conf = mod.get_config()
+        reader = mod.train_reader()
+        feeder = getattr(mod, "feeder", None)
+        if feeder is None:
+            raise SystemExit(f"{args.config} must define feeder(batch)")
     trainer = SGD(model_conf, opt_conf)
-    reader = mod.train_reader()
-    feeder = getattr(mod, "feeder", None)
-    if feeder is None:
-        raise SystemExit(f"{args.config} must define feeder(batch)")
 
     def handler(ev):
         if isinstance(ev, events.EndIteration) and (
@@ -91,6 +102,42 @@ def cmd_train(args):
         save_dir=args.save_dir or None,
     )
     return 0
+
+
+def _v1_train_setup(config_path, config_args):
+    """Build (model, opt, batched_reader, feeder) from an unmodified v1
+    config: parse it, load its data-provider module, annotate data-layer
+    slot types from the provider declaration, and wire the feeder by
+    data-layer order (tuple samples) or name (dict samples)."""
+    from paddle_tpu.compat.config_parser import (
+        apply_data_types,
+        parse_config,
+    )
+    from paddle_tpu.data.feeder import DataFeeder
+    from paddle_tpu.data.reader import batched
+
+    tc = parse_config(config_path, config_args)
+    if tc.data_sources is None or not tc.data_sources.train_list:
+        raise SystemExit(
+            f"{config_path} declares no train data source "
+            "(define_py_data_sources2)"
+        )
+    reader_creator, types = tc.data_sources.train_reader()
+    apply_data_types(tc.model, types)
+    data_names = [
+        lc.name for lc in tc.model.layers if lc.type == "data"
+    ]
+    if isinstance(types, dict):
+        feeding = {n: n for n in types}
+        type_map = dict(types)
+    else:
+        feeding = {n: i for i, n in enumerate(data_names)}
+        type_map = dict(zip(data_names, types))
+    feeder = DataFeeder(feeding, type_map)
+    reader = batched(
+        reader_creator, tc.opt.batch_size, drop_last=False
+    )
+    return tc.model, tc.opt, reader, feeder
 
 
 def cmd_merge_model(args):
@@ -206,6 +253,8 @@ def main(argv=None):
 
     sp = sub.add_parser("train", help="train a config")
     sp.add_argument("--config", required=True)
+    sp.add_argument("--config_args", default="",
+                    help="v1 config interpolation, e.g. batch_size=64")
     sp.add_argument("--num_passes", type=int, default=1)
     sp.add_argument("--save_dir", default="")
     sp.add_argument("--log_period", type=int, default=10)

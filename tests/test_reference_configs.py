@@ -336,3 +336,51 @@ class TestNetworkCompare:
             np.asarray(oa["out"].value), np.asarray(ob["out"].value),
             atol=1e-6,
         )
+
+
+class TestV1TrainCLI:
+    def test_paddle_train_runs_reference_config(self, tmp_path):
+        """`python -m paddle_tpu train --config <reference config>` —
+        the `paddle train` CLI path (TrainerMain.cpp:32): model,
+        optimizer, AND data provider all come from the unmodified
+        config file."""
+        import subprocess
+        import sys
+
+        d = tmp_path / "data"
+        d.mkdir()
+        words = ["the", "movie", "was", "great", "bad", "awful", "good"]
+        (d / "dict.txt").write_text(
+            "".join(f"{w}\t{i}\n" for i, w in enumerate(words))
+        )
+        (d / "train.txt").write_text(
+            "1\tthe movie was great good\n"
+            "0\tthe movie was bad awful\n"
+            "1\tgreat good movie\n"
+            "0\tawful bad\n"
+        )
+        (d / "train.list").write_text("data/train.txt\n")
+        (d / "test.list").write_text("data/train.txt\n")
+
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "train",
+             "--config",
+             f"{REF}/v1_api_demo/quick_start/trainer_config.lr.py",
+             "--num_passes", "3", "--log_period", "1"],
+            capture_output=True, text=True, cwd=tmp_path, env=env,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-3000:]
+        costs = [
+            float(ln.split()[-1])
+            for ln in out.stdout.splitlines()
+            if ln.startswith("pass ")
+        ]
+        assert len(costs) == 3
+        assert costs[-1] < costs[0]  # it learns
