@@ -66,9 +66,7 @@ def cmd_train(args):
     from paddle_tpu.trainer import SGD
     from paddle_tpu.trainer import events
 
-    with open(args.config) as f:
-        is_v1 = "def get_config" not in f.read()
-    if is_v1:
+    if _is_v1_config(args.config):
         # UNMODIFIED reference v1 config: the `paddle train --config X
         # --config_args Y` path (trainer/TrainerMain.cpp:32 +
         # config_parser.py:3724) — model + optimizer + data provider
@@ -102,6 +100,16 @@ def cmd_train(args):
         save_dir=args.save_dir or None,
     )
     return 0
+
+
+def _is_v1_config(path: str) -> bool:
+    """A config without any mention of get_config (defined, imported,
+    or aliased) is an unmodified v1 file for compat parse_config.
+    `get_config_arg` (the v1 --config_args accessor) must NOT count."""
+    import re
+
+    with open(path) as f:
+        return re.search(r"get_config(?!_arg)", f.read()) is None
 
 
 def _v1_train_setup(config_path, config_args):
@@ -220,16 +228,14 @@ def cmd_make_diagram(args):
     `paddle make_diagram`, scripts/submit_local.sh.in:3-13)."""
     from paddle_tpu.plot import make_diagram
 
-    with open(args.config) as f:
-        src = f.read()
-    if "def get_config" in src:
-        mod = _load_config(args.config)
-        model_conf, _ = mod.get_config()
-    else:
+    if _is_v1_config(args.config):
         # an unmodified v1 config file (settings()/outputs() style)
         from paddle_tpu.compat.config_parser import parse_config
 
         model_conf = parse_config(args.config, args.config_args).model
+    else:
+        mod = _load_config(args.config)
+        model_conf, _ = mod.get_config()
     dot = make_diagram(model_conf, title=args.config)
     if args.output:
         with open(args.output, "w") as f:

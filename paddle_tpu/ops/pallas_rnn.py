@@ -666,6 +666,7 @@ def _gru_bwd_pallas(x, w_g, w_c, b, lens, y, dy, *, interpret):
     if bb < 32 and not interpret:
         return None
     f32 = jnp.float32
+    wg_dt, wc_dt = w_g.dtype, w_c.dtype  # cotangents match the primals
     w_g = w_g.astype(f32)
     w_c = w_c.astype(f32)
     b2 = b.astype(f32)[None, :]
@@ -714,8 +715,8 @@ def _gru_bwd_pallas(x, w_g, w_c, b, lens, y, dy, *, interpret):
     )(xp, w_g, w_c, b2, lensp, yp_, yp_, dyp)
     return (
         dx[:bsz, :t_max].astype(orig),
-        dwg.astype(w_g.dtype),
-        dwc.astype(w_c.dtype),
+        dwg.astype(wg_dt),
+        dwc.astype(wc_dt),
         db3[0],
     )
 

@@ -97,22 +97,28 @@ def make_diagram(model_conf, title: str = "model") -> str:
     python/paddle/utils/make_model_diagram.py). Pure text, no graphviz
     dependency: render with `dot -Tpng model.dot -o model.png`."""
     shapes = {"data": "box", "mixed": "hexagon"}
+
+    def esc(s):
+        # single escaping rule for EVERY quoted dot string (ids,
+        # labels, and the digraph title)
+        return str(s).replace('"', "'")
+
+    def q(name):
+        return '"' + esc(name) + '"'
+
     lines = [
-        f'digraph "{title}" {{',
+        f"digraph {q(title)} {{",
         "  rankdir=TB;",
         '  node [fontsize=10, shape=ellipse, style=filled,'
         ' fillcolor="#e8eef7"];',
     ]
-
-    def q(name):
-        return '"' + name.replace('"', "'") + '"'
 
     for lc in model_conf.layers:
         shape = shapes.get(lc.type, "ellipse")
         fill = "#f7e8e8" if "cost" in lc.type or lc.type in (
             "classification_cost", "cross_entropy", "mse_cost",
         ) else ("#e8f7ea" if lc.type == "data" else "#e8eef7")
-        label = f"{lc.name}\\n{lc.type}"
+        label = f"{esc(lc.name)}\\n{esc(lc.type)}"
         if lc.size:
             label += f" [{lc.size}]"
         lines.append(

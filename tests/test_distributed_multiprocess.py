@@ -95,10 +95,18 @@ def _run_phase(phase, port, ckpt_dir):
             )
         )
     outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=180)
-        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
-        outs.append(out)
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+            outs.append(out)
+    finally:
+        # a failed/hung worker must not leak its sibling, which would
+        # otherwise block forever on the 2-process rendezvous
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     return outs
 
 
