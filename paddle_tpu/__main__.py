@@ -83,6 +83,36 @@ def cmd_train(args):
             raise SystemExit(f"{args.config} must define feeder(batch)")
     trainer = SGD(model_conf, opt_conf)
 
+    if args.job == "time":
+        # --job=time (trainer/TrainerBenchmark.cpp, the harness behind
+        # the reference's published numbers, benchmark/paddle/image/
+        # run.sh:10): warm up, then report ms/batch over the next
+        # batches
+        import itertools
+        import time as _time
+
+        want = args.time_batches + 5
+        batches = list(
+            itertools.islice(
+                itertools.chain.from_iterable(
+                    iter(reader()) for _ in itertools.count()
+                ),
+                want,
+            )
+        )
+        if not batches:
+            raise SystemExit("data source produced no batches")
+        feeds = [feeder(b) for b in batches]
+        for f in feeds[:5]:  # warmup/compile
+            trainer.train_batch(f)
+        t0 = _time.perf_counter()
+        for f in feeds[5:]:
+            trainer.train_batch(f)
+        n = len(feeds) - 5
+        ms = (_time.perf_counter() - t0) / max(n, 1) * 1e3
+        print(f"time: {ms:.3f} ms/batch over {n} batches")
+        return 0
+
     def handler(ev):
         if isinstance(ev, events.EndIteration) and (
             ev.batch_id % args.log_period == 0
@@ -261,6 +291,9 @@ def main(argv=None):
     sp.add_argument("--config", required=True)
     sp.add_argument("--config_args", default="",
                     help="v1 config interpolation, e.g. batch_size=64")
+    sp.add_argument("--job", choices=["train", "time"], default="train",
+                    help="time = ms/batch harness (TrainerBenchmark.cpp)")
+    sp.add_argument("--time_batches", type=int, default=10)
     sp.add_argument("--num_passes", type=int, default=1)
     sp.add_argument("--save_dir", default="")
     sp.add_argument("--log_period", type=int, default=10)

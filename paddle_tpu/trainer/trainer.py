@@ -139,6 +139,24 @@ class SGD:
                 float(jnp.max(jnp.abs(v))),
             )
 
+    def train_batch(self, feed) -> float:
+        """Run ONE jitted train step on an already-fed Arg dict and
+        return the cost — the TrainerInternal::trainOneBatch unit
+        (TrainerInternal.cpp:66), used by the --job=time harness."""
+        rng = _rng.split_for_step(self.step_key, self.global_step)
+        (
+            self.params,
+            self.opt_state,
+            self.state,
+            loss,
+            _,
+        ) = self.step_fn(
+            self.params, self.opt_state, self.state, feed,
+            self.global_step, rng,
+        )
+        self.global_step += 1
+        return float(loss)
+
     def train(
         self,
         reader: Callable,
