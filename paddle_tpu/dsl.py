@@ -229,6 +229,17 @@ def lstmemory(x, size, name=None, act="tanh", gate_act="sigmoid",
                 active_state_type=state_act, reversed=reversed)
 
 
+def mdlstm(x, size, name=None, act="tanh", gate_act="sigmoid",
+           state_act="tanh", directions=(True, True), bias=True,
+           param=None):
+    """2-D multi-dimensional LSTM over a [H, W, 5*size] grid
+    (gserver/layers/MDLstmLayer.cpp)."""
+    return _add("mdlstm", [x], name=name, size=size, act=act, bias=bias,
+                param=param, active_gate_type=gate_act,
+                active_state_type=state_act,
+                directions=tuple(directions))
+
+
 def grumemory(x, size, name=None, act="tanh", gate_act="sigmoid",
               reversed=False, bias=True, param=None):
     return _add("grumemory", [x], name=name, size=size, act=act, bias=bias,
