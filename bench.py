@@ -171,7 +171,7 @@ def bench_lstm(bs, hidden):
     return {"value": round(ms, 3), "unit": "ms/batch"}
 
 
-def bench_lstm_fused_vs_scan(bs=128, hidden=512):
+def bench_lstm_fused_vs_scan(bs=128, hidden=256):
     """Fused Pallas LSTM (fwd + reverse-time bwd kernels) vs the
     lax.scan lowering, same TRAINING step. value = scan_ms / fused_ms
     (>1: the kernel beats the scan path)."""

@@ -32,15 +32,29 @@ from paddle_tpu.ops import activations
 from paddle_tpu.ops import sequence_ops as sops
 
 
-def _use_fused() -> bool:
-    """Fused Pallas cell policy: flag override, else auto (TPU only)."""
+def _use_fused(bsz=None, t_max=None, h=None, mult=4) -> bool:
+    """Fused Pallas cell policy: flag override, else auto — real TPU
+    AND a shape where the backward kernel engages (bb >= 32 plan).
+    Measured on v5e: when only the forward kernel fits (h=512+), the
+    fused-fwd + scan-recompute hybrid ties or loses to the pure scan
+    for training, so auto only engages where the full fused train path
+    wins. Force with flags.set_flag('use_pallas_rnn', True/False)."""
     from paddle_tpu.core.flags import get_flag
     from paddle_tpu.ops import pallas_rnn
 
     v = get_flag("use_pallas_rnn")
-    if v is None:
-        return pallas_rnn.use_fused_default()
-    return bool(v)
+    if v is not None:
+        return bool(v)
+    if not pallas_rnn.use_fused_default():
+        return False
+    if bsz is None:
+        return True
+    plan = (
+        pallas_rnn._lstm_bwd_plan(bsz, t_max, h)
+        if mult == 4
+        else pallas_rnn._gru_bwd_plan(bsz, t_max, h)
+    )
+    return plan is not None and plan[0] >= 32
 
 
 def _interpret_mode() -> bool:
@@ -146,7 +160,9 @@ class LstmLayer(Layer):
             and self.conf.attrs.get("active_gate_type", "sigmoid") == "sigmoid"
             and self.conf.attrs.get("active_state_type", "tanh") == "tanh"
         )
-        if default_acts and _use_fused():
+        if default_acts and _use_fused(
+            arg.value.shape[0], arg.value.shape[1], h, mult=4
+        ):
             from paddle_tpu.ops import pallas_rnn
 
             x = arg.value
@@ -214,7 +230,9 @@ class GruLayer(Layer):
             (self.conf.active_type or "tanh") == "tanh"
             and self.conf.attrs.get("active_gate_type", "sigmoid") == "sigmoid"
         )
-        if default_acts and _use_fused():
+        if default_acts and _use_fused(
+            arg.value.shape[0], arg.value.shape[1], h, mult=3
+        ):
             from paddle_tpu.ops import pallas_rnn
 
             x = arg.value

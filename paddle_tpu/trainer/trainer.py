@@ -236,6 +236,9 @@ class SGD:
                     meta={"global_step": self.global_step},
                     save_only_one=_flags.get_flag("save_only_one"),
                 )
+            # per-pass timer report (the WITH_TIMER StatSet dump,
+            # TrainerInternal.cpp:177 area / utils/Stat.h:189)
+            log.info("pass %d %s", pass_id, GLOBAL_STATS.report())
             event_handler(EndPass(pass_id, results))
 
     def test(self, reader: Callable, feeder: Callable) -> dict:
