@@ -122,6 +122,20 @@ class DataFeeder:
                     ids[i, : len(s)] = np.asarray(s, np.int64)
                 return Arg(ids=ids, seq_lens=lens)
             v = np.zeros((b, tmax) + t.dim, np.float32)
+            if t.kind in ("sparse_binary", "sparse_float"):
+                # sequence of sparse rows: each timestep is an index
+                # list (or (indices, values)) — PyDataProvider2's
+                # sparse_*_vector_sequence slots
+                for i, s in enumerate(column):
+                    for ti, row in enumerate(s):
+                        if t.kind == "sparse_binary":
+                            v[i, ti, np.asarray(row, np.int64)] = 1.0
+                        else:
+                            idx, vals = row
+                            v[i, ti, np.asarray(idx, np.int64)] = (
+                                np.asarray(vals, np.float32)
+                            )
+                return Arg(value=v, seq_lens=lens)
             for i, s in enumerate(column):
                 v[i, : len(s)] = np.asarray(s, np.float32).reshape(
                     (len(s),) + t.dim

@@ -172,3 +172,27 @@ class TestImage:
         assert im.shape == (40, 60, 3)
         chw = pimg.load_and_transform(p, 32, 24, is_train=True)
         assert chw.shape == (3, 24, 24)
+
+
+def test_sparse_sequence_feeding():
+    """sparse_binary/float_vector SEQUENCE slots
+    (PyDataProvider2.py sparse_*_vector_sequence): each timestep is an
+    index list / (indices, values) pair, densified per step."""
+    from paddle_tpu.data.feeder import (
+        DataFeeder,
+        sparse_binary_vector,
+        sparse_float_vector,
+    )
+
+    f = DataFeeder({"x": 0}, {"x": sparse_binary_vector(6, seq_type=1)})
+    a = f([([[0, 2], [5]],), ([[1]],)])
+    v = np.asarray(a["x"].value)
+    assert v.shape[0] == 2 and v.shape[2] == 6
+    assert v[0, 0, 0] == 1 and v[0, 0, 2] == 1 and v[0, 1, 5] == 1
+    assert v[0, 0].sum() == 2 and v[1, 0, 1] == 1 and v[1, 1:].sum() == 0
+    assert list(np.asarray(a["x"].seq_lens)) == [2, 1]
+
+    f2 = DataFeeder({"x": 0}, {"x": sparse_float_vector(4, seq_type=1)})
+    a2 = f2([([([1, 3], [0.5, 2.0])],)])
+    v2 = np.asarray(a2["x"].value)
+    assert v2[0, 0, 1] == 0.5 and v2[0, 0, 3] == 2.0
