@@ -88,20 +88,19 @@ def cmd_train(args):
         # the reference's published numbers, benchmark/paddle/image/
         # run.sh:10): warm up, then report ms/batch over the next
         # batches
-        import itertools
         import time as _time
 
         want = args.time_batches + 5
-        batches = list(
-            itertools.islice(
-                itertools.chain.from_iterable(
-                    iter(reader()) for _ in itertools.count()
-                ),
-                want,
-            )
-        )
-        if not batches:
-            raise SystemExit("data source produced no batches")
+        batches = []
+        while len(batches) < want:
+            got_any = False
+            for b in reader():
+                got_any = True
+                batches.append(b)
+                if len(batches) == want:
+                    break
+            if not got_any:  # empty source: error out, don't spin
+                raise SystemExit("data source produced no batches")
         feeds = [feeder(b) for b in batches]
         for f in feeds[:5]:  # warmup/compile
             trainer.train_batch(f)

@@ -320,7 +320,8 @@ class MDLstmLayer(Layer):
             wco = params["b"][8 * h : 9 * h]
         else:
             z = jnp.zeros((h,), arg.value.dtype)
-            gb, wci, wcf_r, wcf_c, wco = (jnp.zeros((5 * h,)),) + (z,) * 4
+            gb = jnp.zeros((5 * h,), arg.value.dtype)
+            wci = wcf_r = wcf_c = wco = z
 
         x = arg.value.reshape(
             (arg.value.shape[0],) + (gh, gw, 5 * h)

@@ -237,8 +237,10 @@ class SGD:
                     save_only_one=_flags.get_flag("save_only_one"),
                 )
             # per-pass timer report (the WITH_TIMER StatSet dump,
-            # TrainerInternal.cpp:177 area / utils/Stat.h:189)
+            # TrainerInternal.cpp:177 area / utils/Stat.h:189) —
+            # reset after logging so each pass reports only itself
             log.info("pass %d %s", pass_id, GLOBAL_STATS.report())
+            GLOBAL_STATS.reset()
             event_handler(EndPass(pass_id, results))
 
     def test(self, reader: Callable, feeder: Callable) -> dict:
