@@ -296,7 +296,7 @@ def bench_resnet50(bs=256):
     }
 
 
-def bench_nmt(bs=128, t=32, hidden=512, vocab=30000, emb=512):
+def bench_nmt(bs=256, t=32, hidden=512, vocab=30000, emb=512):
     """Seq2seq NMT with attention (north star). Tokens/s counts target
     tokens (the decoder steps driving the attention + softmax work)."""
     from paddle_tpu.core.arg import id_arg
