@@ -83,6 +83,43 @@ def cmd_train(args):
             raise SystemExit(f"{args.config} must define feeder(batch)")
     trainer = SGD(model_conf, opt_conf)
 
+    if args.job == "test":
+        # --job=test: evaluation-only pass over the config's TEST data
+        # source (trainer/Tester.h; `paddle train --job=test`),
+        # optionally on a saved checkpoint (--save_dir/--pass_id =
+        # --init_model_path semantics)
+        if args.save_dir:
+            trainer.resume(args.save_dir, args.pass_id)
+        if _is_v1_config(args.config):
+            from paddle_tpu.compat.config_parser import parse_config
+            from paddle_tpu.data.reader import batched
+
+            tc = parse_config(args.config, args.config_args)
+            if tc.data_sources is None or not tc.data_sources.test_list:
+                raise SystemExit(
+                    f"{args.config} declares no test data source"
+                )
+            rc, types = tc.data_sources.test_reader()
+            _, _, _, test_feeder = _v1_train_setup(
+                args.config, args.config_args
+            )
+            test_reader = batched(
+                rc, tc.opt.batch_size, drop_last=False
+            )
+            feeder_t = test_feeder
+        else:
+            mod = _load_config(args.config)
+            test_reader = mod.test_reader()
+            feeder_t = feeder
+        res = trainer.test(test_reader, feeder_t)
+        print(
+            f"test cost {res['cost']:.6f} "
+            + " ".join(
+                f"{k}={v}" for k, v in res["evaluators"].items()
+            )
+        )
+        return 0
+
     if args.job == "time":
         # --job=time (trainer/TrainerBenchmark.cpp, the harness behind
         # the reference's published numbers, benchmark/paddle/image/
@@ -290,9 +327,13 @@ def main(argv=None):
     sp.add_argument("--config", required=True)
     sp.add_argument("--config_args", default="",
                     help="v1 config interpolation, e.g. batch_size=64")
-    sp.add_argument("--job", choices=["train", "time"], default="train",
-                    help="time = ms/batch harness (TrainerBenchmark.cpp)")
+    sp.add_argument("--job", choices=["train", "time", "test"],
+                    default="train",
+                    help="time = ms/batch harness (TrainerBenchmark"
+                         ".cpp); test = evaluation pass (Tester.h)")
     sp.add_argument("--time_batches", type=int, default=10)
+    sp.add_argument("--pass_id", type=int, default=-1,
+                    help="with --job=test --save_dir: checkpoint pass")
     sp.add_argument("--num_passes", type=int, default=1)
     sp.add_argument("--save_dir", default="")
     sp.add_argument("--log_period", type=int, default=10)

@@ -419,3 +419,37 @@ class TestV1TrainCLI:
         ]
         ms = float(line.split()[1])
         assert 0 < ms < 10_000
+
+    def test_paddle_train_job_test(self, tmp_path):
+        """--job=test: evaluation-only pass over the config's test
+        data source (`paddle train --job=test`, trainer/Tester.h)."""
+        import subprocess
+        import sys
+
+        d = tmp_path / "data"
+        d.mkdir()
+        (d / "dict.txt").write_text("a\t0\nb\t1\n")
+        (d / "train.txt").write_text("1\ta b\n0\tb a\n")
+        (d / "train.list").write_text("data/train.txt\n")
+        (d / "test.list").write_text("data/train.txt\n")
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            ),
+        )
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu", "train",
+             "--config",
+             f"{REF}/v1_api_demo/quick_start/trainer_config.lr.py",
+             "--job", "test"],
+            capture_output=True, text=True, cwd=tmp_path, env=env,
+            timeout=300,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        (line,) = [
+            ln for ln in out.stdout.splitlines()
+            if ln.startswith("test cost ")
+        ]
+        cost = float(line.split()[2])
+        assert np.isfinite(cost) and 0 < cost < 5
