@@ -184,6 +184,25 @@ class SequenceGenerator:
     def setBeamSize(self, k: int):
         self.decoder.k = k
 
+    def registerBeamSearchControlCallbacks(
+        self, adjust=None, drop=None, stop=None
+    ):
+        """User beam-control hooks, executed host-side each step
+        (RecurrentGradientMachine.h:143-152
+        registerBeamSearchControlCallbacks; see
+        beam_search.BeamHooks for the signatures)."""
+        from paddle_tpu.beam_search import BeamHooks
+
+        self.decoder.hooks = BeamHooks(
+            adjust=adjust, drop=drop, stop=stop
+        )
+
+    def removeBeamSearchControlCallbacks(self):
+        """(RecurrentGradientMachine.h:155) back to plain beam search."""
+        from paddle_tpu.beam_search import BeamHooks
+
+        self.decoder.hooks = BeamHooks()
+
     def generate(self, statics: Sequence[Arg], boots=None):
         seqs, lens, scores = self.decoder.generate(
             self.params, list(statics), boots=boots

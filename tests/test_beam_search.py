@@ -277,3 +277,46 @@ class TestHostHooks:
         seqs, lens = np.asarray(seqs), np.asarray(lens)
         assert seqs[0, 0, :3].tolist() == [2, 3, self.EOS]
         assert lens[0, 0] == 3
+
+
+def test_api_sequence_generator_hook_registration():
+    """api.SequenceGenerator.registerBeamSearchControlCallbacks
+    (RecurrentGradientMachine.h:143-155): hooks registered through the
+    SWIG-parity surface change generation; removing them restores plain
+    beam search."""
+    from paddle_tpu.api import SequenceGenerator
+
+    v, eos = 5, 1
+
+    def step(word):
+        emb = dsl.embedding(word, size=v, vocab_size=v,
+                            param=ParameterConf(name="bg_api"))
+        return dsl.mixed(v, [(emb, "identity")], act="softmax",
+                         bias=False, name="prob")
+
+    dec = BeamSearchDecoder(step, n_static=0, bos_id=0, eos_id=eos,
+                            beam_size=2, max_length=5)
+    table = np.full((v, v), -4.0, np.float32)
+    table[0, 2] = 3.0
+    table[0, 4] = 2.0
+    table[2, 3] = 3.0
+    table[4, 3] = 3.0
+    table[3, eos] = 3.0
+    params = {"bg_api": jnp.asarray(table)}
+    gen = SequenceGenerator(dec, params)
+
+    seqs = dec.generate(params, statics=[], batch_size=1)[0]
+    assert np.asarray(seqs)[0, 0, 0] == 2  # best path starts with 2
+
+    def adjust(logp, t):
+        logp = logp.copy()
+        logp[:, :, 2] = -1e30
+        return logp
+
+    gen.registerBeamSearchControlCallbacks(adjust=adjust)
+    seqs2 = dec.generate(params, statics=[], batch_size=1)[0]
+    assert np.asarray(seqs2)[0, 0, 0] == 4  # rerouted around token 2
+
+    gen.removeBeamSearchControlCallbacks()
+    seqs3 = dec.generate(params, statics=[], batch_size=1)[0]
+    assert np.asarray(seqs3)[0, 0, 0] == 2
