@@ -222,6 +222,44 @@ class TestQuickStartConfigs:
         assert (2,) in fc_dims
 
 
+    def test_quick_start_predict_mode(self, tmp_path, monkeypatch):
+        """is_predict=1 (--config_args): the same unmodified config
+        switches to its prediction branch — maxid + prob outputs, no
+        cost layers, the process_predict provider — and runs inference
+        (the predict.sh path)."""
+        self._setup_quick_start_data(tmp_path)
+        (tmp_path / "data" / "pred.list").write_text("data/train.txt\n")
+        monkeypatch.chdir(tmp_path)
+        tc = parse_config(
+            f"{REF}/v1_api_demo/quick_start/trainer_config.lr.py",
+            "is_predict=1",
+        )
+        assert len(tc.model.output_layer_names) == 2  # [maxid, prob]
+        assert not any("cost" in l.type for l in tc.model.layers)
+        net = Network(tc.model)
+        params = net.init_params(jax.random.key(0))
+        mod = load_provider_module(
+            "dataprovider_bow", tc.data_sources.search_dir
+        )
+        provider = getattr(mod, tc.data_sources.obj)  # process_predict
+        assert tc.data_sources.obj == "process_predict"
+        reader = provider(
+            [str(tmp_path / "data" / "train.txt")],
+            **tc.data_sources.args,
+        )
+        types = provider.input_types
+        feeder = DataFeeder({n: n for n in types}, types)
+        feed = feeder(list(reader()))
+        outs, _ = net.forward(
+            params, feed, outputs=tc.model.output_layer_names
+        )
+        maxid, prob = tc.model.output_layer_names
+        ids = np.asarray(outs[maxid].ids)
+        probs = np.asarray(outs[prob].value)
+        assert ids.shape == (4,) and probs.shape == (4, 2)
+        np.testing.assert_array_equal(ids, probs.argmax(axis=1))
+
+
 class TestNetworkCompare:
     """Two different configs, same function — the
     trainer/tests/test_NetworkCompare.cpp discipline (e.g. its
