@@ -66,52 +66,41 @@ def cmd_train(args):
     from paddle_tpu.trainer import SGD
     from paddle_tpu.trainer import events
 
+    # --job=test needs only the config's TEST data source; everything
+    # else drives the train source. The config is parsed exactly once.
+    which = "test" if args.job == "test" else "train"
     if _is_v1_config(args.config):
         # UNMODIFIED reference v1 config: the `paddle train --config X
         # --config_args Y` path (trainer/TrainerMain.cpp:32 +
         # config_parser.py:3724) — model + optimizer + data provider
         # all come from the config file itself
-        model_conf, opt_conf, reader, feeder = _v1_train_setup(
-            args.config, args.config_args
+        model_conf, opt_conf, reader, feeder = _v1_setup(
+            args.config, args.config_args, which
         )
     else:
         mod = _load_config(args.config)
         model_conf, opt_conf = mod.get_config()
-        reader = mod.train_reader()
+        if which == "test":
+            if not hasattr(mod, "test_reader"):
+                raise SystemExit(
+                    f"{args.config} must define test_reader() for "
+                    "--job=test"
+                )
+            reader = mod.test_reader()
+        else:
+            reader = mod.train_reader()
         feeder = getattr(mod, "feeder", None)
         if feeder is None:
             raise SystemExit(f"{args.config} must define feeder(batch)")
     trainer = SGD(model_conf, opt_conf)
 
     if args.job == "test":
-        # --job=test: evaluation-only pass over the config's TEST data
-        # source (trainer/Tester.h; `paddle train --job=test`),
-        # optionally on a saved checkpoint (--save_dir/--pass_id =
-        # --init_model_path semantics)
+        # evaluation-only pass (trainer/Tester.h; `paddle train
+        # --job=test`), optionally on a saved checkpoint
+        # (--save_dir/--pass_id = --init_model_path semantics)
         if args.save_dir:
             trainer.resume(args.save_dir, args.pass_id)
-        if _is_v1_config(args.config):
-            from paddle_tpu.compat.config_parser import parse_config
-            from paddle_tpu.data.reader import batched
-
-            tc = parse_config(args.config, args.config_args)
-            if tc.data_sources is None or not tc.data_sources.test_list:
-                raise SystemExit(
-                    f"{args.config} declares no test data source"
-                )
-            rc, types = tc.data_sources.test_reader()
-            _, _, _, test_feeder = _v1_train_setup(
-                args.config, args.config_args
-            )
-            test_reader = batched(
-                rc, tc.opt.batch_size, drop_last=False
-            )
-            feeder_t = test_feeder
-        else:
-            mod = _load_config(args.config)
-            test_reader = mod.test_reader()
-            feeder_t = feeder
-        res = trainer.test(test_reader, feeder_t)
+        res = trainer.test(reader, feeder)
         print(
             f"test cost {res['cost']:.6f} "
             + " ".join(
@@ -178,11 +167,12 @@ def _is_v1_config(path: str) -> bool:
         return re.search(r"get_config(?!_arg)", f.read()) is None
 
 
-def _v1_train_setup(config_path, config_args):
+def _v1_setup(config_path, config_args, which="train"):
     """Build (model, opt, batched_reader, feeder) from an unmodified v1
-    config: parse it, load its data-provider module, annotate data-layer
-    slot types from the provider declaration, and wire the feeder by
-    data-layer order (tuple samples) or name (dict samples)."""
+    config: parse it ONCE, load the data-provider module for the
+    requested source (train or test), annotate data-layer slot types
+    from that provider's declaration, and wire the feeder by data-layer
+    order (tuple samples) or name (dict samples)."""
     from paddle_tpu.compat.config_parser import (
         apply_data_types,
         parse_config,
@@ -191,12 +181,15 @@ def _v1_train_setup(config_path, config_args):
     from paddle_tpu.data.reader import batched
 
     tc = parse_config(config_path, config_args)
-    if tc.data_sources is None or not tc.data_sources.train_list:
+    ds = tc.data_sources
+    if ds is None or not getattr(ds, f"{which}_list"):
         raise SystemExit(
-            f"{config_path} declares no train data source "
+            f"{config_path} declares no {which} data source "
             "(define_py_data_sources2)"
         )
-    reader_creator, types = tc.data_sources.train_reader()
+    reader_creator, types = (
+        ds.train_reader() if which == "train" else ds.test_reader()
+    )
     apply_data_types(tc.model, types)
     data_names = [
         lc.name for lc in tc.model.layers if lc.type == "data"
