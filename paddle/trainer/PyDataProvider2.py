@@ -25,3 +25,4 @@ def sparse_binary_vector_sequence(dim):
 
 def sparse_vector_sequence(dim):
     return sparse_float_vector(dim, seq_type=1)
+integer_sequence = integer_value_sequence

@@ -74,7 +74,7 @@ def cmd_train(args):
         # --config_args Y` path (trainer/TrainerMain.cpp:32 +
         # config_parser.py:3724) — model + optimizer + data provider
         # all come from the config file itself
-        model_conf, opt_conf, reader, feeder = _v1_setup(
+        model_conf, opt_conf, reader, feeder, evaluators = _v1_setup(
             args.config, args.config_args, which
         )
     else:
@@ -92,7 +92,8 @@ def cmd_train(args):
         feeder = getattr(mod, "feeder", None)
         if feeder is None:
             raise SystemExit(f"{args.config} must define feeder(batch)")
-    trainer = SGD(model_conf, opt_conf)
+        evaluators = getattr(mod, "evaluators", None) or []
+    trainer = SGD(model_conf, opt_conf, evaluators=evaluators)
 
     if args.job == "test":
         # evaluation-only pass (trainer/Tester.h; `paddle train
@@ -194,17 +195,22 @@ def _v1_setup(config_path, config_args, which="train"):
     data_names = [
         lc.name for lc in tc.model.layers if lc.type == "data"
     ]
+    # the config's inputs() declaration fixes provider-slot order
+    order = [
+        n for n in (tc.model.input_layer_names or data_names)
+        if n in data_names
+    ] or data_names
     if isinstance(types, dict):
         feeding = {n: n for n in types}
         type_map = dict(types)
     else:
-        feeding = {n: i for i, n in enumerate(data_names)}
-        type_map = dict(zip(data_names, types))
+        feeding = {n: i for i, n in enumerate(order)}
+        type_map = dict(zip(order, types))
     feeder = DataFeeder(feeding, type_map)
     reader = batched(
         reader_creator, tc.opt.batch_size, drop_last=False
     )
-    return tc.model, tc.opt, reader, feeder
+    return tc.model, tc.opt, reader, feeder, tc.evaluators
 
 
 def cmd_merge_model(args):

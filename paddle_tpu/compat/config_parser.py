@@ -27,6 +27,8 @@ __all__ = [
     "settings",
     "define_py_data_sources2",
     "outputs",
+    "inputs",
+    "default_device",
     "parse_config",
     "load_provider_module",
     "TrainerConfig",
@@ -45,6 +47,22 @@ __all__ = [
     # attrs / poolings (trainer_config_helpers/{attrs,poolings}.py)
     "ExtraAttr",
     "ExtraLayerAttribute",
+    "ModelAverage",
+    # evaluator declarations (trainer_config_helpers/evaluators.py)
+    "classification_error_evaluator",
+    "sum_evaluator",
+    "column_sum_evaluator",
+    "chunk_evaluator",
+    "ctc_error_evaluator",
+    "precision_recall_evaluator",
+    "auc_evaluator",
+    "pnpair_evaluator",
+    "value_printer_evaluator",
+    "gradient_printer_evaluator",
+    "maxid_printer_evaluator",
+    "maxframe_printer_evaluator",
+    "seqtext_printer_evaluator",
+    "classification_error_printer_evaluator",
     "MaxPooling",
     "AvgPooling",
     "SumPooling",
@@ -60,6 +78,8 @@ class _ParseCtx:
         self.opt = OptimizationConf()
         self.data_sources: Optional[DataSources] = None
         self.outputs: list = []
+        self.inputs: list = []
+        self.evaluators: list = []
 
 
 _stack: list = []  # innermost parse context last
@@ -157,11 +177,24 @@ class L1Regularization(_OptSetting):
         self.fields = {"l1_rate": rate}
 
 
+class ModelAverage(_OptSetting):
+    """settings(model_average=ModelAverage(...)) — the AverageOptimizer
+    window (trainer_config_helpers/optimizers.py ModelAverage)."""
+
+    def __init__(self, average_window, max_average_window=0,
+                 do_average_in_cpu=False):
+        self.fields = {
+            "average_window": average_window,
+            "max_average_window": max_average_window,
+        }
+
+
 def settings(batch_size=256, learning_rate=0.01, learning_method=None,
              regularization=None, gradient_clipping_threshold=None,
              learning_rate_decay_a=0.0, learning_rate_decay_b=0.0,
              learning_rate_schedule=None, learning_rate_args="",
-             average_window=0, max_average_window=0, **_):
+             average_window=0, max_average_window=0,
+             model_average=None, **_):
     """trainer_config_helpers `settings(...)` -> OptimizationConf
     (config_parser.py:3576 Settings)."""
     ctx = _ctx()
@@ -178,7 +211,7 @@ def settings(batch_size=256, learning_rate=0.01, learning_method=None,
     o.max_average_window = max_average_window
     if gradient_clipping_threshold is not None:
         o.gradient_clipping_threshold = gradient_clipping_threshold
-    for setting in (learning_method, regularization):
+    for setting in (learning_method, regularization, model_average):
         if setting is not None:
             for k, v in setting.fields.items():
                 setattr(o, k, v)
@@ -268,6 +301,103 @@ def define_py_data_sources2(train_list=None, test_list=None, module="",
     return ctx.data_sources
 
 
+# ---- evaluator declarations (trainer_config_helpers/evaluators.py) --
+
+def _declare_evaluator(type_, input=None, label=None, name=None, **kw):
+    ctx = _ctx()
+    assert ctx is not None, "evaluator declared outside parse_config"
+    conf = {"type": type_}
+    if name:
+        conf["name"] = name
+    if input is not None:
+        conf["input"] = getattr(input, "name", input)
+    if label is not None:
+        conf["label"] = getattr(label, "name", label)
+    for k, v in kw.items():
+        if v is not None:
+            conf[k] = v
+    ctx.evaluators.append(conf)
+    return conf
+
+
+def classification_error_evaluator(input, label, name=None, **kw):
+    return _declare_evaluator(
+        "classification_error", input, label, name, **kw
+    )
+
+
+def sum_evaluator(input, name=None, **kw):
+    return _declare_evaluator("sum", input, None, name, **kw)
+
+
+def column_sum_evaluator(input, name=None, **kw):
+    return _declare_evaluator("column_sum", input, None, name, **kw)
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None, **kw):
+    return _declare_evaluator(
+        "chunk", input, label, name, chunk_scheme=chunk_scheme,
+        num_chunk_types=num_chunk_types,
+        excluded_chunk_types=excluded_chunk_types, **kw
+    )
+
+
+def ctc_error_evaluator(input, label, name=None, **kw):
+    return _declare_evaluator(
+        "ctc_edit_distance", input, label, name, **kw
+    )
+
+
+def precision_recall_evaluator(input, label, name=None, **kw):
+    return _declare_evaluator(
+        "precision_recall", input, label, name, **kw
+    )
+
+
+def auc_evaluator(input, label, name=None, **kw):
+    return _declare_evaluator("rankauc", input, label, name, **kw)
+
+
+def pnpair_evaluator(input, label, query_id, name=None, **kw):
+    return _declare_evaluator(
+        "pnpair", input, label, name,
+        query_id=getattr(query_id, "name", query_id), **kw
+    )
+
+
+def value_printer_evaluator(input, name=None, **kw):
+    return _declare_evaluator("value_printer", input, None, name, **kw)
+
+
+def gradient_printer_evaluator(input, name=None, **kw):
+    return _declare_evaluator(
+        "gradient_printer", input, None, name, **kw
+    )
+
+
+def maxid_printer_evaluator(input, name=None, **kw):
+    return _declare_evaluator("max_id_printer", input, None, name, **kw)
+
+
+def maxframe_printer_evaluator(input, name=None, **kw):
+    return _declare_evaluator(
+        "max_frame_printer", input, None, name, **kw
+    )
+
+
+def seqtext_printer_evaluator(input, name=None, **kw):
+    return _declare_evaluator(
+        "seq_text_printer", input, None, name, **kw
+    )
+
+
+def classification_error_printer_evaluator(input, label, name=None, **kw):
+    return _declare_evaluator(
+        "classification_error_printer", input, label, name, **kw
+    )
+
+
 def outputs(*layer_refs):
     """Mark output/cost layers (trainer_config_helpers `outputs`)."""
     ctx = _ctx()
@@ -276,6 +406,27 @@ def outputs(*layer_refs):
     for r in layer_refs:
         flat += list(r) if isinstance(r, (list, tuple)) else [r]
     ctx.outputs = [getattr(r, "name", r) for r in flat]
+
+
+def default_device(device: int) -> None:
+    """v1 per-layer device placement default (config_parser.py
+    default_device, consumed by ParallelNeuralNetwork). Devices are a
+    mesh concern here (per-layer `out_sharding` GSPMD hints); the
+    global default is a no-op under one compiled program."""
+    ctx = _ctx()
+    assert ctx is not None, "default_device() outside parse_config"
+
+
+def inputs(*layer_refs):
+    """Declare the network's input layers and their FEED ORDER
+    (trainer_config_helpers `inputs`) — the order data-provider slots
+    map onto data layers."""
+    ctx = _ctx()
+    assert ctx is not None, "inputs() outside parse_config"
+    flat = []
+    for r in layer_refs:
+        flat += list(r) if isinstance(r, (list, tuple)) else [r]
+    ctx.inputs = [getattr(r, "name", r) for r in flat]
 
 
 # ---- the parser ----------------------------------------------------------
@@ -288,6 +439,7 @@ class TrainerConfig:
     opt: OptimizationConf
     data_sources: Optional[DataSources]
     args: dict
+    evaluators: list = field(default_factory=list)
 
 
 def _parse_args(config_args) -> dict:
@@ -334,13 +486,16 @@ def parse_config(config_file: str, config_args="") -> TrainerConfig:
         for name in ctx.outputs:
             if name not in conf.output_layer_names:
                 conf.output_layer_names.append(name)
+    if ctx.inputs:
+        # inputs() fixes the data-layer FEED ORDER
+        conf.input_layer_names = list(ctx.inputs)
     if ctx.data_sources is not None:
         ctx.data_sources.search_dir = os.path.dirname(
             os.path.abspath(config_file)
         )
     return TrainerConfig(
         model=conf, opt=ctx.opt, data_sources=ctx.data_sources,
-        args=ctx.args,
+        args=ctx.args, evaluators=ctx.evaluators,
     )
 
 

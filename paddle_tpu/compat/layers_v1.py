@@ -418,7 +418,15 @@ def scaling_projection(input, param_attr=None, **_):
 
 
 def table_projection(input, size=0, param_attr=None, **_):
-    return (_one(input), "table")
+    x = _one(input)
+    lc = x.builder.conf.layer(x.name)
+    # ids slot feeding a lookup table: annotate a raw data layer the
+    # same way embedding_layer does (v1 slot types came from the
+    # provider declaration)
+    if lc.type == "data" and not lc.attrs.get("is_ids"):
+        lc.attrs["is_ids"] = True
+        lc.attrs["is_seq"] = True
+    return (x, "table", {"vocab_size": lc.size})
 
 
 def context_projection(input, context_len, context_start=None, **_):
@@ -510,7 +518,10 @@ def hsigmoid(input, label, num_classes, name=None, param_attr=None,
                     param=param_attr)
 
 
-def crf_layer(input, label, size, param_attr=None, name=None, **_):
+def crf_layer(input, label, size=None, param_attr=None, name=None, **_):
+    # v1 infers size from the input's width when omitted
+    # (trainer_config_helpers/layers.py crf_layer)
+    size = size or _layer_size(_one(input))
     return dsl.crf(input, label, num_tags=size, name=name,
                    param=param_attr)
 

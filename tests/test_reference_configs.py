@@ -491,3 +491,57 @@ class TestV1TrainCLI:
         ]
         cost = float(line.split()[2])
         assert np.isfinite(cost) and 0 < cost < 5
+
+
+class TestSequenceTaggingConfigs:
+    """v1_api_demo/sequence_tagging: linear-CRF and RNN-CRF taggers
+    parse UNMODIFIED — incl. evaluator declarations (sum_evaluator,
+    chunk_evaluator), ModelAverage, inputs() feed order, sparse_update
+    ParamAttr, and mixed_layer table projections — and train on
+    synthetic CoNLL-shaped batches."""
+
+    def _parse(self, name, monkeypatch):
+        monkeypatch.chdir(f"{REF}/v1_api_demo/sequence_tagging")
+        return parse_config(name)
+
+    def test_linear_crf_parses_and_trains(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.arg import Arg
+
+        tc = self._parse("linear_crf.py", monkeypatch)
+        assert [e["type"] for e in tc.evaluators] == ["sum", "chunk"]
+        assert tc.evaluators[1]["chunk_scheme"] == "IOB"
+        assert tc.opt.average_window == 0.5
+        assert tc.model.input_layer_names == [
+            "word", "pos", "chunk", "features"
+        ]
+        net = Network(tc.model)
+        # synthetic batch: features sparse seq densified, chunk labels
+        rng = np.random.default_rng(0)
+        B, T, C = 2, 5, 24
+        feats = (rng.uniform(0, 1, (B, T, 76328)) < 2e-5).astype(
+            np.float32
+        )
+        lens = np.asarray([5, 3], np.int32)
+        feed = {
+            "features": Arg(value=jnp.asarray(feats),
+                            seq_lens=jnp.asarray(lens)),
+            "chunk": Arg(ids=jnp.asarray(
+                rng.integers(0, C, (B, T)), jnp.int32
+            ), seq_lens=jnp.asarray(lens)),
+        }
+        losses, _, _ = _train_steps(tc, feed, steps=3)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_rnn_crf_parses_and_builds(self, monkeypatch):
+        tc = self._parse("rnn_crf.py", monkeypatch)
+        net = Network(tc.model)
+        types_ = [l.type for l in tc.model.layers]
+        assert "crf" in types_ and "mixed" in types_
+        # the mixed table projection created a sparse-update lookup
+        assert any(
+            pc.sparse_update for pc in net.param_confs.values()
+        )
+        assert len(net.param_confs) >= 10
