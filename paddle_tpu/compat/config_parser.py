@@ -29,6 +29,13 @@ __all__ = [
     "outputs",
     "inputs",
     "default_device",
+    "Settings",
+    "Inputs",
+    "Outputs",
+    "default_momentum",
+    "default_decay_rate",
+    "default_initial_std",
+    "default_initial_mean",
     "parse_config",
     "load_provider_module",
     "TrainerConfig",
@@ -80,6 +87,7 @@ class _ParseCtx:
         self.outputs: list = []
         self.inputs: list = []
         self.evaluators: list = []
+        self.param_defaults: dict = {}
 
 
 _stack: list = []  # innermost parse context last
@@ -408,6 +416,62 @@ def outputs(*layer_refs):
     ctx.outputs = [getattr(r, "name", r) for r in flat]
 
 
+def Settings(algorithm="sgd", batch_size=256, learning_rate=0.01,
+             learning_method=None, learning_rate_decay_a=0.0,
+             learning_rate_decay_b=0.0, learning_rate_schedule=None,
+             **kw):
+    """The raw config_parser `Settings(...)` spelling
+    (config_parser.py:3576): like settings() but learning_method is a
+    STRING and `algorithm` is accepted ('sgd' — async modes are out of
+    scope, PARITY.md)."""
+    ctx = _ctx()
+    assert ctx is not None, "Settings() outside parse_config"
+    o = ctx.opt
+    o.batch_size = batch_size
+    o.learning_rate = learning_rate
+    if learning_method:
+        o.learning_method = learning_method
+    o.learning_rate_decay_a = learning_rate_decay_a
+    o.learning_rate_decay_b = learning_rate_decay_b
+    if learning_rate_schedule:
+        o.learning_rate_schedule = learning_rate_schedule
+    for k, v in kw.items():
+        if hasattr(o, k) and v is not None:
+            setattr(o, k, v)
+    return o
+
+
+def default_momentum(v: float) -> None:
+    """config_parser default_momentum: the momentum used where no
+    per-parameter momentum is configured."""
+    ctx = _ctx()
+    assert ctx is not None
+    ctx.opt.momentum = v
+
+
+def default_decay_rate(v: float) -> None:
+    """config_parser default_decay_rate (L2)."""
+    ctx = _ctx()
+    assert ctx is not None
+    ctx.opt.l2_rate = v
+
+
+def default_initial_std(v: float) -> None:
+    """config_parser default_initial_std: recorded; per-param
+    ParamAttr(initial_std=...) remains the precise control (the
+    framework's default init is the reference's 'smart' 1/sqrt(fan_in)
+    already)."""
+    ctx = _ctx()
+    assert ctx is not None
+    ctx.param_defaults["initial_std"] = v
+
+
+def default_initial_mean(v: float) -> None:
+    ctx = _ctx()
+    assert ctx is not None
+    ctx.param_defaults["initial_mean"] = v
+
+
 def default_device(device: int) -> None:
     """v1 per-layer device placement default (config_parser.py
     default_device, consumed by ParallelNeuralNetwork). Devices are a
@@ -420,7 +484,8 @@ def default_device(device: int) -> None:
 def inputs(*layer_refs):
     """Declare the network's input layers and their FEED ORDER
     (trainer_config_helpers `inputs`) — the order data-provider slots
-    map onto data layers."""
+    map onto data layers. Accepts refs or names (the raw config_parser
+    `Inputs(...)` spelling)."""
     ctx = _ctx()
     assert ctx is not None, "inputs() outside parse_config"
     flat = []
@@ -539,3 +604,9 @@ def load_provider_module(name_or_path: str, search_dir: str = ""):
         code = compile(f.read(), path, "exec")
     exec(code, mod.__dict__)
     return mod
+
+
+# raw config_parser spellings (config_parser.py Inputs/Outputs take
+# layer NAMES)
+Inputs = inputs
+Outputs = outputs

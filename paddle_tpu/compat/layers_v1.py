@@ -99,6 +99,8 @@ __all__ = [
     "bidirectional_lstm",
     "simple_img_conv_pool",
     "img_conv_group",
+    "small_vgg",
+    "vgg_16_network",
     "sub_nested_seq_layer",
     "get_output_layer",
     "memory",
@@ -669,8 +671,8 @@ def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
 
 def img_conv_group(input, conv_num_filter, conv_filter_size, pool_size,
                    pool_stride, conv_act=None, conv_with_batchnorm=False,
-                   pool_type=None, num_channels=None, conv_padding=None,
-                   **_):
+                   conv_batchnorm_drop_rate=None, pool_type=None,
+                   num_channels=None, conv_padding=None, **_):
     """A VGG block (networks.py:333 img_conv_group)."""
     h = _one(input)
     n = len(conv_num_filter)
@@ -679,6 +681,9 @@ def img_conv_group(input, conv_num_filter, conv_filter_size, pool_size,
     bns = (conv_with_batchnorm
            if isinstance(conv_with_batchnorm, (list, tuple))
            else [conv_with_batchnorm] * n)
+    drops = (conv_batchnorm_drop_rate
+             if isinstance(conv_batchnorm_drop_rate, (list, tuple))
+             else [conv_batchnorm_drop_rate] * n)
     act = _act_or(conv_act, "relu")
     for i, (nf, fs, bn) in enumerate(zip(conv_num_filter, fss, bns)):
         pad = (conv_padding[i]
@@ -690,6 +695,8 @@ def img_conv_group(input, conv_num_filter, conv_filter_size, pool_size,
                      num_channels=num_channels if i == 0 else None)
         if bn:
             h = dsl.batch_norm(h, act=act)
+            if drops[i]:
+                h = dsl.dropout(h, drops[i])
     return dsl.pool(h, pool_size, pool_stride,
                     pool_type=_pool_type(pool_type))
 
@@ -722,3 +729,62 @@ def memory(name, size, boot_layer=None, **_):
 def recurrent_group(step, input, name=None, reverse=False, **_):
     return dsl.recurrent_group(step, _many(input), name=name,
                                reversed=reverse)
+
+
+def small_vgg(input_image, num_channels, num_classes, **_):
+    """(networks.py:435 small_vgg): 4 VGG blocks with batch-norm +
+    per-conv dropout, pool, dropout, fc(512)+bn+relu, softmax fc."""
+
+    def block(ipt, num_filter, times, dropouts, num_channels_=None):
+        return img_conv_group(
+            input=ipt,
+            num_channels=num_channels_,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * times,
+            conv_filter_size=3,
+            conv_act=ReluActivation(),
+            conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts,
+            pool_type="max",
+        )
+
+    tmp = block(input_image, 64, 2, [0.3, 0], num_channels)
+    tmp = block(tmp, 128, 2, [0.4, 0])
+    tmp = block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = img_pool_layer(input=tmp, stride=2, pool_size=2)
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=512, act=LinearActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = batch_norm_layer(input=tmp, act=ReluActivation())
+    return fc_layer(input=tmp, size=num_classes,
+                    act=SoftmaxActivation())
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000, **_):
+    """(networks.py:465 vgg_16_network)."""
+
+    def block(ipt, num_filter, times, num_channels_=None):
+        return img_conv_group(
+            input=ipt,
+            num_channels=num_channels_,
+            pool_size=2,
+            pool_stride=2,
+            conv_num_filter=[num_filter] * times,
+            conv_filter_size=3,
+            conv_act=ReluActivation(),
+            pool_type="max",
+        )
+
+    tmp = block(input_image, 64, 2, num_channels)
+    tmp = block(tmp, 128, 2)
+    tmp = block(tmp, 256, 3)
+    tmp = block(tmp, 512, 3)
+    tmp = block(tmp, 512, 3)
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    return fc_layer(input=tmp, size=num_classes,
+                    act=SoftmaxActivation())

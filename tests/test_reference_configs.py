@@ -545,3 +545,33 @@ class TestSequenceTaggingConfigs:
             pc.sparse_update for pc in net.param_confs.values()
         )
         assert len(net.param_confs) >= 10
+
+
+class TestMnistAndModelZooConfigs:
+    """v1_api_demo/mnist and v1_api_demo/model_zoo/resnet configs parse
+    and build UNMODIFIED (small_vgg/vgg networks, Settings/Inputs/
+    Outputs raw spellings, default_momentum/decay_rate)."""
+
+    def test_light_mnist_builds(self, monkeypatch):
+        monkeypatch.chdir(f"{REF}/v1_api_demo/mnist")
+        tc = parse_config("light_mnist.py")
+        net = Network(tc.model)
+        types_ = [l.type for l in tc.model.layers]
+        assert "exconv" in types_ and "batch_norm" in types_
+        assert tc.model.output_layer_names
+
+    def test_vgg16_mnist_builds(self, monkeypatch):
+        monkeypatch.chdir(f"{REF}/v1_api_demo/mnist")
+        tc = parse_config("vgg_16_mnist.py")
+        net = Network(tc.model)
+        assert len(net.param_confs) > 40  # the full small_vgg stack
+
+    @pytest.mark.parametrize("depth,nlayers", [(50, 128), (101, 247)])
+    def test_model_zoo_resnet_builds(self, depth, nlayers, monkeypatch):
+        monkeypatch.chdir(f"{REF}/v1_api_demo/model_zoo/resnet")
+        tc = parse_config("resnet.py", f"layer_num={depth}")
+        assert len(tc.model.layers) == nlayers
+        net = Network(tc.model)
+        assert tc.opt.momentum == 0.9  # default_momentum
+        assert tc.opt.l2_rate == pytest.approx(1e-4)
+        assert tc.opt.learning_rate_schedule == "discexp"
