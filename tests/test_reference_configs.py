@@ -575,3 +575,12 @@ class TestMnistAndModelZooConfigs:
         assert tc.opt.momentum == 0.9  # default_momentum
         assert tc.opt.l2_rate == pytest.approx(1e-4)
         assert tc.opt.learning_rate_schedule == "discexp"
+
+    def test_traffic_prediction_builds(self, monkeypatch):
+        """v1_api_demo/traffic_prediction/trainer_config.py (multi-task
+        gru regression over 97 layers) builds unmodified."""
+        monkeypatch.chdir(f"{REF}/v1_api_demo/traffic_prediction")
+        tc = parse_config("trainer_config.py")
+        net = Network(tc.model)
+        assert len(tc.model.layers) == 97
+        assert len(net.param_confs) > 50
