@@ -47,8 +47,14 @@ def cmd_version(args):
 
 
 def cmd_dump_config(args):
-    mod = _load_config(args.config)
-    model_conf, opt_conf = mod.get_config()
+    if _is_v1_config(args.config):
+        from paddle_tpu.compat.config_parser import parse_config
+
+        tc = parse_config(args.config, args.config_args)
+        model_conf, opt_conf = tc.model, tc.opt
+    else:
+        mod = _load_config(args.config)
+        model_conf, opt_conf = mod.get_config()
     doc = {
         "model": json.loads(model_conf.to_json()),
         "optimization": vars(opt_conf),
@@ -340,6 +346,7 @@ def main(argv=None):
 
     sp = sub.add_parser("dump_config", help="print config as JSON")
     sp.add_argument("--config", required=True)
+    sp.add_argument("--config_args", default="")
     sp.add_argument("--output", default="")
     sp.set_defaults(fn=cmd_dump_config)
 
