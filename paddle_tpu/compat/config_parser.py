@@ -87,7 +87,6 @@ class _ParseCtx:
         self.outputs: list = []
         self.inputs: list = []
         self.evaluators: list = []
-        self.param_defaults: dict = {}
 
 
 _stack: list = []  # innermost parse context last
@@ -314,6 +313,15 @@ def define_py_data_sources2(train_list=None, test_list=None, module="",
 def _declare_evaluator(type_, input=None, label=None, name=None, **kw):
     ctx = _ctx()
     assert ctx is not None, "evaluator declared outside parse_config"
+    if isinstance(input, (list, tuple)):
+        # printer-style evaluators accept several inputs: one conf each
+        return [
+            _declare_evaluator(
+                type_, x, label,
+                f"{name}_{i}" if name and i else name, **kw
+            )
+            for i, x in enumerate(input)
+        ]
     conf = {"type": type_}
     if name:
         conf["name"] = name
@@ -367,10 +375,16 @@ def auc_evaluator(input, label, name=None, **kw):
     return _declare_evaluator("rankauc", input, label, name, **kw)
 
 
-def pnpair_evaluator(input, label, query_id, name=None, **kw):
+def pnpair_evaluator(input, label, info=None, query_id=None, name=None,
+                     **kw):
+    """The reference names the query-id slot `info`
+    (trainer_config_helpers/evaluators.py pnpair_evaluator); accept
+    both spellings."""
+    q = info if info is not None else query_id
+    assert q is not None, "pnpair_evaluator needs info= (query ids)"
     return _declare_evaluator(
         "pnpair", input, label, name,
-        query_id=getattr(query_id, "name", query_id), **kw
+        query_id=getattr(q, "name", q), **kw
     )
 
 
@@ -457,19 +471,28 @@ def default_decay_rate(v: float) -> None:
 
 
 def default_initial_std(v: float) -> None:
-    """config_parser default_initial_std: recorded; per-param
-    ParamAttr(initial_std=...) remains the precise control (the
-    framework's default init is the reference's 'smart' 1/sqrt(fan_in)
-    already)."""
-    ctx = _ctx()
-    assert ctx is not None
-    ctx.param_defaults["initial_std"] = v
+    """config_parser default_initial_std. NOT threaded into implicit
+    parameter creation: the framework's default init is already the
+    reference's 'smart' 1/sqrt(fan_in); use per-param
+    ParamAttr(initial_std=...) for exact control. Logged so silent
+    divergence is visible."""
+    import logging
+
+    logging.getLogger("paddle_tpu.compat").info(
+        "default_initial_std(%s): framework keeps smart init; set "
+        "ParamAttr(initial_std=...) per parameter for exact parity", v,
+    )
 
 
 def default_initial_mean(v: float) -> None:
-    ctx = _ctx()
-    assert ctx is not None
-    ctx.param_defaults["initial_mean"] = v
+    """See default_initial_std — logged, not applied implicitly."""
+    import logging
+
+    if v:
+        logging.getLogger("paddle_tpu.compat").warning(
+            "default_initial_mean(%s) is not applied to implicitly "
+            "created parameters; use ParamAttr(initial_mean=...)", v,
+        )
 
 
 def default_device(device: int) -> None:
@@ -569,16 +592,26 @@ def apply_data_types(model: ModelConf, input_types) -> None:
     in v1 the slot type (dense/ids/sparse × seq level) came from the
     data-provider declaration (PyDataProvider2.py:47-214), not from the
     config's data_layer calls. `input_types` is a dict name->InputType
-    or a list in data-layer declaration order."""
-    data_layers = [lc for lc in model.layers if lc.type == "data"]
+    or a list in SLOT order — which is the config's inputs()
+    declaration (model.input_layer_names) when present, else data-layer
+    declaration order."""
+    data_layers = {
+        lc.name: lc for lc in model.layers if lc.type == "data"
+    }
     if isinstance(input_types, dict):
         pairs = [
-            (lc, input_types[lc.name])
-            for lc in data_layers
-            if lc.name in input_types
+            (data_layers[n], t)
+            for n, t in input_types.items()
+            if n in data_layers
         ]
     else:
-        pairs = list(zip(data_layers, input_types))
+        order = [
+            n for n in (model.input_layer_names or data_layers)
+            if n in data_layers
+        ] or list(data_layers)
+        pairs = [
+            (data_layers[n], t) for n, t in zip(order, input_types)
+        ]
     for lc, t in pairs:
         lc.attrs["is_ids"] = t.kind == "ids"
         lc.attrs["is_seq"] = t.seq >= 1
