@@ -584,3 +584,18 @@ class TestMnistAndModelZooConfigs:
         net = Network(tc.model)
         assert len(tc.model.layers) == 97
         assert len(net.param_confs) > 50
+
+    @pytest.mark.parametrize(
+        "mode", ["discriminator_training", "generator_training",
+                 "generator"]
+    )
+    def test_gan_conf_parses(self, mode, monkeypatch):
+        """v1_api_demo/gan/gan_conf.py parses in all three of its
+        --config_args modes (the GAN freeze/swap protocol configs)."""
+        monkeypatch.chdir(f"{REF}/v1_api_demo/gan")
+        tc = parse_config("gan_conf.py", f"mode={mode}")
+        net = Network(tc.model)
+        assert len(tc.model.layers) >= 5
+        if mode != "generator":
+            # training modes end in a cost over the discriminator
+            assert tc.model.output_layer_names
