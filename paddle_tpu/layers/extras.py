@@ -415,3 +415,42 @@ class PrintLayer(Layer):
         # treated as a format field
         jax.debug.print("{}: {}", self.name, v)
         return x
+
+
+@LAYERS.register("get_output")
+class GetOutputLayer(Layer):
+    """Forward a named extra output of the single input layer
+    (gserver/layers/GetOutputLayer.cpp:39; config_parser.py:3135).
+
+    The edge's ``input_layer_argument`` selects which argument: the
+    builder resolves extra outputs under ``<producer>@<arg>`` spec
+    names (the same canonical form dsl.get_output emits), so this layer
+    normalizes its input edge to that key and is otherwise the
+    identity. Mirrors the reference's init checks: exactly one input
+    with a non-empty argument name."""
+
+    def __init__(self, conf, model):
+        super().__init__(conf, model)
+        if len(conf.inputs) != 1:
+            raise ValueError(
+                f"get_output layer {conf.name!r} needs exactly 1 input, "
+                f"got {len(conf.inputs)}"
+            )
+        edge = conf.inputs[0]
+        if "@" not in edge.name:
+            arg = edge.attrs.get("input_layer_argument")
+            if not arg:
+                raise ValueError(
+                    f"get_output layer {conf.name!r} input edge must set "
+                    f"attrs['input_layer_argument'] (the named output of "
+                    f"{edge.name!r} to forward)"
+                )
+            edge.name = f"{edge.name}@{arg}"
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        return s, {}
+
+    def forward(self, params, inputs, ctx):
+        (x,) = inputs
+        return x

@@ -263,7 +263,7 @@ class GruLayer(Layer):
         return Arg(value=y, seq_lens=arg.seq_lens)
 
 
-@LAYERS.register("mdlstm")
+@LAYERS.register("mdlstm", "mdlstmemory")
 class MDLstmLayer(Layer):
     """2-D multi-dimensional LSTM (gserver/layers/MDLstmLayer.cpp):
     each grid cell takes the hidden/cell states of its row- and
