@@ -3,7 +3,13 @@ entry point (python/paddle/trainer/config_parser.py:3724) backed by
 paddle_tpu.compat.config_parser.
 """
 
+import logging
+
 from paddle_tpu.compat.config_parser import (  # noqa: F401
     get_config_arg,
     parse_config,
 )
+
+# the reference module's glog-backed logger the api demo drivers import
+# (v1_api_demo/vae/vae_train.py:23)
+logger = logging.getLogger("paddle_tpu.config_parser")

@@ -6,3 +6,4 @@ attrs,poolings,activations}.py), backed by paddle_tpu.compat.
 
 from paddle_tpu.compat.config_parser import *  # noqa: F401,F403
 from paddle_tpu.compat.layers_v1 import *  # noqa: F401,F403
+from paddle_tpu.compat import layer_math  # noqa: F401  (patches LayerRef ops)

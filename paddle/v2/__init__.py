@@ -1,0 +1,93 @@
+"""paddle.v2 — the reference's flagship user API, TPU-native.
+
+Reference: python/paddle/v2/__init__.py — `import paddle.v2 as paddle`
+then paddle.init(...), paddle.layer.*, paddle.batch, paddle.trainer.SGD,
+paddle.infer. Layer calls build an ambient graph (the analogue of
+cp.begin_parse()'s global config); Topology prunes it per trainer/infer.
+
+The SWIG/GradientMachine substrate is replaced by paddle_tpu's
+jit-compiled Network/TrainStep; `use_gpu` and co. map onto paddle_tpu
+flags where a TPU-side equivalent exists and are ignored (with the
+reference's permissive env-var semantics) where they are GPU-specific.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import (  # noqa: F401
+    activation,
+    attr,
+    config_base,
+    data_feeder,
+    data_type,
+    dataset,
+    evaluator,
+    event,
+    image,
+    inference,
+    layer,
+    master,
+    minibatch,
+    model,
+    networks,
+    op,
+    optimizer,
+    parameters,
+    plot,
+    pooling,
+    reader,
+    topology,
+    trainer,
+)
+
+__all__ = [
+    "optimizer", "layer", "activation", "parameters", "init", "trainer",
+    "event", "data_type", "attr", "pooling", "dataset", "reader",
+    "topology", "networks", "infer", "plot", "evaluator", "image",
+    "master", "model", "batch",
+]
+
+# open the ambient config graph (the reference's cp.begin_parse())
+config_base.global_graph()
+
+batch = minibatch.batch
+infer = inference.infer
+
+# v2 init kwargs / PADDLE_INIT_* env vars -> paddle_tpu flags
+_FLAG_MAP = {
+    "seed": "seed",
+    "log_period": "log_period",
+    "show_parameter_stats_period": "show_parameter_stats_period",
+    "save_dir": "save_dir",
+    "saving_period": "saving_period",
+    "start_pass": "start_pass",
+    "beam_size": "beam_size",
+}
+
+
+def init(**kwargs):
+    """paddle.init(use_gpu=..., trainer_count=..., seed=...) — the
+    reference collects PADDLE_INIT_* env vars plus kwargs into gflags
+    (v2/__init__.py:63-88). Device placement is the mesh's job here:
+    trainer_count maps to the data-mesh axis; use_gpu is accepted and
+    ignored (the backend is TPU/XLA)."""
+    from paddle_tpu.core import flags as _flags
+
+    args = {}
+    for ek, ev in os.environ.items():
+        if ek.startswith("PADDLE_INIT_"):
+            args[ek.replace("PADDLE_INIT_", "").lower()] = str(ev)
+    args.update(kwargs)
+
+    for k, v in args.items():
+        if k in _FLAG_MAP:
+            _flags.set_flag(_FLAG_MAP[k], type(_flags.get_flag(_FLAG_MAP[k]))(v)
+                            if _flags.get_flag(_FLAG_MAP[k]) is not None
+                            else v)
+        elif k == "trainer_count":
+            n = int(v)
+            if n > 1:
+                _flags.set_flag("mesh_shape", {"data": n})
+        # use_gpu, gpu_id, parallel_nn, ... are device-model specific
+        # to the reference; accepted and ignored.

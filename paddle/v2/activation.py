@@ -1,0 +1,30 @@
+"""paddle.v2.activation — v2 names for the activation objects.
+
+Reference: python/paddle/v2/activation.py (strips the `Activation`
+suffix from trainer_config_helpers.activations class names).
+"""
+
+from paddle_tpu.compat.layers_v1 import _make_act as __make
+
+Linear = __make("Linear", "")
+Identity = Linear
+Relu = __make("Relu", "relu")
+Sigmoid = __make("Sigmoid", "sigmoid")
+Softmax = __make("Softmax", "softmax")
+SequenceSoftmax = __make("SequenceSoftmax", "sequence_softmax")
+Tanh = __make("Tanh", "tanh")
+STanh = __make("STanh", "stanh")
+BRelu = __make("BRelu", "brelu")
+SoftRelu = __make("SoftRelu", "softrelu")
+Abs = __make("Abs", "abs")
+Square = __make("Square", "square")
+Exp = __make("Exp", "exponential")
+Log = __make("Log", "log")
+Sqrt = __make("Sqrt", "sqrt")
+Reciprocal = __make("Reciprocal", "reciprocal")
+
+__all__ = [
+    "Linear", "Identity", "Relu", "Sigmoid", "Softmax", "SequenceSoftmax",
+    "Tanh", "STanh", "BRelu", "SoftRelu", "Abs", "Square", "Exp", "Log",
+    "Sqrt", "Reciprocal",
+]

@@ -1,0 +1,13 @@
+"""paddle.v2.attr — Param/Extra attribute aliases.
+
+Reference: python/paddle/v2/attr.py (Param = ParameterAttribute,
+Extra = ExtraLayerAttribute).
+"""
+
+from paddle_tpu.compat.layers_v1 import ParamAttr as Param
+from paddle_tpu.compat.config_parser import ExtraLayerAttribute as Extra
+
+ParamAttr = Param
+ExtraAttr = Extra
+
+__all__ = ["Param", "Extra", "ParamAttr", "ExtraAttr"]

@@ -1,0 +1,48 @@
+"""Ambient config state for the v2 API.
+
+Reference: python/paddle/v2/config_base.py + the global config that
+`paddle.trainer.config_parser.begin_parse()` opens at import
+(python/paddle/v2/__init__.py:62). In v2, layer functions are called at
+script top level with no explicit graph scope; every call appends to one
+process-global graph, and `Topology(cost)` later extracts the ancestor
+closure of the requested outputs.
+
+Here the global graph is a paddle_tpu.dsl.GraphBuilder pushed
+permanently onto the dsl scope stack, plus two side tables the v2
+surface needs: data-layer input types (v2's `layer.data(type=...)`)
+and evaluator declarations (`paddle.v2.evaluator.*`).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import dsl
+
+# data-layer name -> paddle_tpu.data.feeder.InputType
+DATA_TYPES: dict = {}
+# evaluator conf dicts ({"type", "input", "label", ...}) in declaration
+# order; consumed by trainer.SGD for the topologies that contain them
+EVALUATORS: list = []
+
+_GLOBAL: dsl.GraphBuilder | None = None
+
+
+def global_graph() -> dsl.GraphBuilder:
+    """The ambient v2 graph (created on first use, pushed at the BOTTOM
+    of the dsl scope stack so explicit `with dsl.model()` scopes still
+    nest above it)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = dsl.GraphBuilder()
+        dsl._stack.insert(0, _GLOBAL)
+    return _GLOBAL
+
+
+def reset():
+    """Drop all ambient state (test isolation; the reference gets this
+    by running each config in a fresh process)."""
+    global _GLOBAL
+    if _GLOBAL is not None and _GLOBAL in dsl._stack:
+        dsl._stack.remove(_GLOBAL)
+    _GLOBAL = None
+    DATA_TYPES.clear()
+    EVALUATORS.clear()

@@ -1,0 +1,30 @@
+"""paddle.v2.data_feeder — DataFeeder re-export.
+
+Reference: python/paddle/v2/data_feeder.py (DataFeeder(data_types,
+feeding) converting sample tuples into Arguments). Backed by
+paddle_tpu.data.feeder.DataFeeder (ragged -> packed dense batches).
+"""
+
+from paddle_tpu.data.feeder import DataFeeder as _DataFeeder
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder(_DataFeeder):
+    def __init__(self, feeding, types=None):
+        # v2 call shape: DataFeeder(data_types, feeding) where
+        # data_types is [(name, InputType)]; internal call shape:
+        # DataFeeder(feeding_dict, types_dict)
+        if types is None or (
+            isinstance(feeding, (list, tuple))
+            and feeding
+            and isinstance(feeding[0], (list, tuple))
+        ):
+            data_types, feeding = feeding, types
+            types = dict(data_types)
+            if feeding is None:
+                feeding = {n: i for i, (n, _) in enumerate(data_types)}
+            elif isinstance(feeding, (list, tuple)):
+                feeding = {n: i for i, n in enumerate(feeding)}
+            feeding = {k: v for k, v in feeding.items() if k in types}
+        super().__init__(feeding, types)

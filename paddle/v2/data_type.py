@@ -1,0 +1,44 @@
+"""paddle.v2.data_type — input type declarations.
+
+Reference: python/paddle/v2/data_type.py (re-exports the
+PyDataProvider2 input types). Maps onto paddle_tpu.data.feeder's
+InputType constructors; `sparse_vector` is the v2 spelling of
+sparse_float_vector.
+"""
+
+from paddle_tpu.data.feeder import (
+    InputType,
+    dense_vector,
+    dense_vector_sequence,
+    integer_value,
+    integer_value_sequence,
+    integer_value_sub_sequence,
+    sparse_binary_vector,
+    sparse_float_vector,
+)
+
+sparse_vector = sparse_float_vector
+
+
+def dense_vector_sub_sequence(dim):
+    return dense_vector(dim, 2)
+
+
+def sparse_binary_vector_sequence(dim):
+    return sparse_binary_vector(dim, 1)
+
+
+def sparse_vector_sequence(dim):
+    return sparse_float_vector(dim, 1)
+
+
+sparse_float_vector_sequence = sparse_vector_sequence
+
+__all__ = [
+    "InputType",
+    "dense_vector", "dense_vector_sequence", "dense_vector_sub_sequence",
+    "integer_value", "integer_value_sequence", "integer_value_sub_sequence",
+    "sparse_binary_vector", "sparse_binary_vector_sequence",
+    "sparse_float_vector", "sparse_float_vector_sequence",
+    "sparse_vector", "sparse_vector_sequence",
+]

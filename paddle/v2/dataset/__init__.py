@@ -1,0 +1,21 @@
+"""paddle.v2.dataset — the 13 auto-downloading datasets.
+
+Reference: python/paddle/v2/dataset/__init__.py. Each submodule is the
+paddle_tpu.data.dataset module of the same name, aliased into this
+package so both `paddle.v2.dataset.mnist.train()` and
+`import paddle.v2.dataset.mnist` resolve.
+"""
+
+import importlib
+import sys
+
+__all__ = [
+    "mnist", "imikolov", "imdb", "cifar", "movielens", "conll05",
+    "sentiment", "uci_housing", "wmt14", "mq2007", "flowers", "voc2012",
+    "common",
+]
+
+for _name in __all__:
+    _mod = importlib.import_module(f"paddle_tpu.data.dataset.{_name}")
+    sys.modules[f"{__name__}.{_name}"] = _mod
+    globals()[_name] = _mod

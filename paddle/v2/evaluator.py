@@ -1,0 +1,65 @@
+"""paddle.v2.evaluator — declare metric evaluators on the topology.
+
+Reference: python/paddle/v2/evaluator.py — v2 re-exports the
+trainer_config_helpers evaluator declarations with the `_evaluator`
+suffix stripped (classification_error_evaluator ->
+evaluator.classification_error). A declaration attaches to the ambient
+graph; trainer.SGD picks up every evaluator whose input layers are in
+the trained topology and reports it through event metrics.
+"""
+
+from __future__ import annotations
+
+from . import config_base
+
+__all__ = []
+
+
+def _declare(type_, input=None, label=None, name=None, **kw):
+    config_base.global_graph()
+    if isinstance(input, (list, tuple)):
+        return [
+            _declare(type_, x, label, f"{name}_{i}" if name and i else name,
+                     **kw)
+            for i, x in enumerate(input)
+        ]
+    conf = {"type": type_}
+    conf["name"] = name or type_
+    if input is not None:
+        conf["input"] = getattr(input, "name", input)
+    if label is not None:
+        conf["label"] = getattr(label, "name", label)
+    for k, v in kw.items():
+        if v is not None:
+            conf[k] = v
+    config_base.EVALUATORS.append(conf)
+    return conf
+
+
+def _make(new_name, type_):
+    def fn(input, label=None, name=None, **kw):
+        return _declare(type_, input, label, name, **kw)
+
+    fn.__name__ = new_name
+    fn.__doc__ = f"v2 declaration of the {type_!r} evaluator"
+    __all__.append(new_name)
+    return fn
+
+
+classification_error = _make("classification_error", "classification_error")
+sum = _make("sum", "sum")
+column_sum = _make("column_sum", "column_sum")
+precision_recall = _make("precision_recall", "precision_recall")
+pnpair = _make("pnpair", "pnpair")
+auc = _make("auc", "rankauc")
+chunk = _make("chunk", "chunk")
+ctc_error = _make("ctc_error", "ctc_edit_distance")
+value_printer = _make("value_printer", "value_printer")
+gradient_printer = _make("gradient_printer", "gradient_printer")
+maxid_printer = _make("maxid_printer", "max_id_printer")
+maxframe_printer = _make("maxframe_printer", "max_frame_printer")
+seqtext_printer = _make("seqtext_printer", "seq_text_printer")
+classification_error_printer = _make(
+    "classification_error_printer", "classification_error_printer"
+)
+detection_map = _make("detection_map", "detection_map")

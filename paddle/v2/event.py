@@ -1,0 +1,77 @@
+"""paddle.v2.event — training events with evaluator metrics.
+
+Reference: python/paddle/v2/event.py: BeginPass/EndPass,
+BeginIteration/EndIteration, TestResult; the End* events carry an
+evaluator whose `.metrics` property maps metric name -> value
+(event.py:15-31 WithMetric). Here the evaluator handle wraps the
+already-reduced results of paddle_tpu evaluators, and also offers the
+reference Evaluator getter surface (getNames/getValue) used by
+api-style drivers.
+"""
+
+from __future__ import annotations
+
+
+class EvalResults:
+    """Dict-backed stand-in for the SWIG api.Evaluator handle."""
+
+    def __init__(self, results: dict | None = None):
+        self._results = dict(results or {})
+
+    def getNames(self):
+        return list(self._results)
+
+    def getValue(self, name):
+        return self._results[name]
+
+    def __repr__(self):
+        return " ".join(f"{k}={v}" for k, v in self._results.items())
+
+
+class WithMetric:
+    def __init__(self, evaluator):
+        if isinstance(evaluator, dict):
+            evaluator = EvalResults(evaluator)
+        self.__evaluator__ = evaluator
+
+    @property
+    def metrics(self):
+        return {n: self.__evaluator__.getValue(n)
+                for n in self.__evaluator__.getNames()}
+
+
+class TestResult(WithMetric):
+    def __init__(self, evaluator, cost):
+        super().__init__(evaluator)
+        self.cost = cost
+
+
+class BeginPass:
+    def __init__(self, pass_id):
+        self.pass_id = pass_id
+
+
+class EndPass(WithMetric):
+    def __init__(self, pass_id, evaluator):
+        self.pass_id = pass_id
+        super().__init__(evaluator)
+
+
+class BeginIteration:
+    def __init__(self, pass_id, batch_id):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+
+
+class EndIteration(WithMetric):
+    def __init__(self, pass_id, batch_id, cost, evaluator):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self.cost = cost
+        super().__init__(evaluator)
+
+
+__all__ = [
+    "EvalResults", "WithMetric", "TestResult",
+    "BeginPass", "EndPass", "BeginIteration", "EndIteration",
+]

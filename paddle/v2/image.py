@@ -1,0 +1,7 @@
+"""paddle.v2.image — image loading/augmentation helpers.
+
+Reference: python/paddle/v2/image.py. Backed by paddle_tpu.image.
+"""
+
+from paddle_tpu.image import *  # noqa: F401,F403
+from paddle_tpu.image import __all__  # noqa: F401

@@ -1,0 +1,54 @@
+"""paddle.v2.inference — run a topology forward on numpy samples.
+
+Reference: python/paddle/v2/inference.py:9 (class Inference) and :93
+(infer(output_layer, parameters, input, feeding, field)).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.network import Network
+
+from .data_feeder import DataFeeder
+from .topology import Topology
+
+__all__ = ["Inference", "infer"]
+
+
+class Inference:
+    def __init__(self, output_layer, parameters):
+        if not isinstance(output_layer, (list, tuple)):
+            output_layer = [output_layer]
+        self.__topology__ = Topology(list(output_layer), with_evaluators=False)
+        self.__net__ = Network(self.__topology__.proto())
+        self.__params__ = parameters._to_device()
+        self.__outputs__ = [getattr(x, "name", x) for x in output_layer]
+
+    def infer(self, input, feeding=None, field="value"):
+        types = self.__topology__.data_type()
+        feeder = DataFeeder(types, feeding)
+        feed = feeder(input)
+        outs, _ = self.__net__.forward(
+            self.__params__, feed, train=False, outputs=self.__outputs__
+        )
+        fields = [field] if isinstance(field, str) else list(field)
+        results = []
+        for f in fields:
+            cols = []
+            for name in self.__outputs__:
+                a = outs[name]
+                if f == "value":
+                    cols.append(np.asarray(a.value))
+                elif f == "id":
+                    cols.append(np.asarray(a.ids))
+                else:
+                    raise ValueError(f"unsupported field {f!r}")
+            results.append(cols[0] if len(cols) == 1 else cols)
+        return results[0] if isinstance(field, str) else results
+
+
+def infer(output_layer, parameters, input, feeding=None, field="value"):
+    return Inference(output_layer, parameters).infer(
+        input, feeding=feeding, field=field
+    )

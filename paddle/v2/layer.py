@@ -1,0 +1,97 @@
+"""paddle.v2.layer — the v2 layer namespace.
+
+Reference: python/paddle/v2/layer.py — v2 wraps every
+trainer_config_helpers layer function, renaming per __convert_name__
+(layer.py:56-74): strip the `_layer` suffix, `maxid_layer` -> `max_id`,
+keep `*memory`/`*_seq`/`*_sim`/`hsigmoid`/`*_cost` spellings, and give
+the bare cross-entropy family a `_cost` suffix. `layer.data` takes a
+`paddle.v2.data_type` InputType instead of a raw size (layer.py:89-93).
+
+Every call lands in the ambient global graph (config_base); Topology
+later prunes to the ancestor closure of the requested outputs.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat import layers_v1 as _v1
+
+from . import config_base
+
+__all__ = ["data", "parse_network"]
+
+_KEEP = {"memory"}  # callables re-exported under their v1 name
+
+
+def __convert_name__(inname: str) -> str:
+    if inname == "maxid_layer":
+        return "max_id"
+    if (
+        inname.endswith("memory")
+        or inname.endswith("_seq")
+        or inname.endswith("_sim")
+        or inname == "hsigmoid"
+    ):
+        return inname
+    if inname in (
+        "cross_entropy",
+        "multi_binary_label_cross_entropy",
+        "cross_entropy_with_selfnorm",
+    ):
+        return inname + "_cost"
+    if inname.endswith("_cost"):
+        return inname
+    if inname.endswith("_layer"):
+        return inname[: -len("_layer")]
+    return inname
+
+
+def _wrap(fn, new_name):
+    def wrapped(*args, **kwargs):
+        config_base.global_graph()  # ensure the ambient scope exists
+        return fn(*args, **kwargs)
+
+    wrapped.__name__ = new_name
+    wrapped.__doc__ = fn.__doc__
+    return wrapped
+
+
+for _name in _v1.__all__:
+    if _name in ("model_scope",):
+        continue
+    _obj = getattr(_v1, _name)
+    _new = __convert_name__(_name)
+    if callable(_obj) and not isinstance(_obj, type):
+        globals()[_new] = _wrap(_obj, _new)
+    else:
+        globals()[_new] = _obj
+    if _new not in __all__:
+        __all__.append(_new)
+
+
+def data(name, type, **kwargs):
+    """v2 data layer: width and slot-ness come from the InputType
+    (reference layer.py:89 __data_layer__)."""
+    config_base.global_graph()
+    t = type
+    l = _v1.data_layer(
+        name,
+        t.size,
+        is_ids=(t.kind == "ids"),
+        is_seq=(t.seq >= 1),
+        has_subseq=(t.seq == 2),
+        **kwargs,
+    )
+    config_base.DATA_TYPES[l.name] = t
+    # expose the slot type on the handle (reference layer.py:90 sets
+    # l.data_type; the mnist api driver reads `images.type`)
+    object.__setattr__(l, "type", t)
+    object.__setattr__(l, "data_type", t)
+    return l
+
+
+def parse_network(*outputs, **kwargs):
+    """Return the pruned ModelConf for the given output layers
+    (reference layer.py:263 parse_network -> ModelConfig proto)."""
+    from .topology import Topology
+
+    return Topology(list(outputs), kwargs.get("extra_layers")).proto()

@@ -1,0 +1,10 @@
+"""paddle.v2.master — client for the elastic-input master server.
+
+Reference: python/paddle/v2/master/client.py:15 (ctypes wrapper over the
+Go master's C bridge). Backed by paddle_tpu.data.master_client, which
+speaks the same task-lease protocol to native/src/master_server.cc.
+"""
+
+from paddle_tpu.data.master_client import MasterClient as client
+
+__all__ = ["client"]

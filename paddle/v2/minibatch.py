@@ -1,0 +1,22 @@
+"""paddle.v2.minibatch.batch — group a sample reader into mini-batches.
+
+Reference: python/paddle/v2/minibatch.py:22-41 (yields the trailing
+partial batch too).
+"""
+
+
+def batch(reader, batch_size):
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b:
+            yield b
+
+    return batch_reader
+
+
+__all__ = ["batch"]

@@ -1,0 +1,39 @@
+"""paddle.v2.model — save/load parameters to a shared filesystem path.
+
+Reference: python/paddle/v2/model.py (save_model/load_model with the
+cloud TRAINER_ID election reduced to the coordinator process here —
+model-save election on TPU pods is process_id == 0, the same exactly-
+one-writer guarantee go/master/service.go:467-495 provides via etcd).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+
+from paddle_tpu.core import flags as _flags
+
+__all__ = ["save_model", "load_model"]
+
+
+def mkdir_p(path):
+    try:
+        os.makedirs(path)
+    except OSError as exc:
+        if exc.errno != errno.EEXIST or not os.path.isdir(path):
+            raise
+
+
+def save_model(parameters, path):
+    if _flags.get_flag("process_id") != 0:
+        return  # exactly one writer
+    d = os.path.dirname(path)
+    if d:
+        mkdir_p(d)
+    with open(path, "wb") as f:
+        parameters.to_tar(f)
+
+
+def load_model(parameters, path):
+    with open(path, "rb") as f:
+        parameters.init_from_tar(f)

@@ -1,0 +1,25 @@
+"""paddle.v2.networks — prebuilt network compositions.
+
+Reference: python/paddle/v2/networks.py re-exports
+trainer_config_helpers.networks under the same names
+(simple_img_conv_pool networks.py:145, img_conv_group :333,
+vgg_16_network :465, simple_lstm :548, simple_gru :975,
+bidirectional_lstm :1207, simple_attention :1298).
+"""
+
+from paddle_tpu.compat.layers_v1 import (
+    bidirectional_lstm,
+    img_conv_group,
+    simple_attention,
+    simple_gru,
+    simple_img_conv_pool,
+    simple_lstm,
+    small_vgg,
+    vgg_16_network,
+)
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
+    "simple_lstm", "simple_gru", "bidirectional_lstm",
+    "simple_attention", "small_vgg",
+]

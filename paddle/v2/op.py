@@ -1,0 +1,38 @@
+"""paddle.v2.op — elementwise math ops over layers.
+
+Reference: python/paddle/v2/op.py (unary math ops as identity-projection
+mixed layers with the activation applied; add/sub via dsl arithmetic).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat import layers_v1 as _v1
+
+from . import activation as act
+from . import config_base
+
+__all__ = []
+
+
+def __register_unary_math_op__(op_name, activation):
+    def op(input, name=None):
+        config_base.global_graph()
+        return _v1.mixed_layer(
+            0, [_v1.identity_projection(input)], name=name,
+            act=activation, bias_attr=False,
+        )
+
+    op.__name__ = op_name
+    globals()[op_name] = op
+    __all__.append(op_name)
+
+
+__register_unary_math_op__("exp", act.Exp())
+__register_unary_math_op__("log", act.Log())
+__register_unary_math_op__("abs", act.Abs())
+__register_unary_math_op__("sigmoid", act.Sigmoid())
+__register_unary_math_op__("tanh", act.Tanh())
+__register_unary_math_op__("square", act.Square())
+__register_unary_math_op__("relu", act.Relu())
+__register_unary_math_op__("sqrt", act.Sqrt())
+__register_unary_math_op__("reciprocal", act.Reciprocal())

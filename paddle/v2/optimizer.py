@@ -1,0 +1,115 @@
+"""paddle.v2.optimizer — optimizer (update equation) objects.
+
+Reference: python/paddle/v2/optimizer.py:58-70 (Optimizer base whose
+create_updater picks local/remote) and the concrete classes :103-297
+(Momentum, Adam, Adamax, AdaGrad, DecayedAdaGrad, AdaDelta, RMSProp),
+each forwarding settings kwargs (learning_rate, regularization =
+L1/L2Regularization, model_average = ModelAverage, gradient clipping,
+LR schedules) to trainer_config_helpers.optimizers.settings.
+
+Here an Optimizer owns a paddle_tpu OptimizationConf; on TPU the
+"updater" is the sharded jit step itself (parallel/dp.py), so
+create_updater collapses away.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.compat.config_parser import (  # re-exported for user code
+    L1Regularization,
+    L2Regularization,
+    ModelAverage,
+)
+
+__all__ = [
+    "Optimizer", "Momentum", "Adam", "Adamax", "AdaGrad",
+    "DecayedAdaGrad", "AdaDelta", "RMSProp",
+    "L1Regularization", "L2Regularization", "ModelAverage",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_method="sgd", **kwargs):
+        o = OptimizationConf()
+        o.learning_method = learning_method
+        o.learning_rate = kwargs.pop("learning_rate", 0.01)
+        o.batch_size = kwargs.pop("batch_size", 1)
+        o.learning_rate_decay_a = kwargs.pop("learning_rate_decay_a", 0.0)
+        o.learning_rate_decay_b = kwargs.pop("learning_rate_decay_b", 0.0)
+        schedule = kwargs.pop("learning_rate_schedule", None)
+        if schedule:
+            o.learning_rate_schedule = schedule
+        o.learning_rate_args = kwargs.pop("learning_rate_args", "")
+        gct = kwargs.pop("gradient_clipping_threshold", None)
+        if gct is not None:
+            o.gradient_clipping_threshold = gct
+        for setting_kw in ("regularization", "model_average"):
+            setting = kwargs.pop(setting_kw, None)
+            if setting is not None:
+                for k, v in setting.fields.items():
+                    setattr(o, k, v)
+        for k, v in kwargs.items():  # direct OptimizationConf fields
+            if hasattr(o, k):
+                setattr(o, k, v)
+        self.conf = o
+
+    def enable_types(self):
+        """Parameter buffer kinds the optimizer maintains (reference
+        optimizer.py:44-54); informational here — opt state lives in
+        the jit step's optimizer-state pytree."""
+        return ["value", "gradient"]
+
+    def create_local_updater(self):
+        """api-driven training path (reference optimizer.py:56-58):
+        returns the swig-api ParameterUpdater for this optimizer."""
+        from paddle_tpu.compat.swig_api import ParameterUpdater
+
+        return ParameterUpdater.createLocalUpdater(self.conf)
+
+
+class Momentum(Optimizer):
+    def __init__(self, momentum=None, sparse=False, **kwargs):
+        super().__init__(
+            "momentum", momentum=momentum or 0.0, **kwargs
+        )
+
+
+class Adam(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(
+            "adam", adam_beta1=beta1, adam_beta2=beta2,
+            adam_epsilon=epsilon, **kwargs
+        )
+
+
+class Adamax(Optimizer):
+    def __init__(self, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(
+            "adamax", adam_beta1=beta1, adam_beta2=beta2, **kwargs
+        )
+
+
+class AdaGrad(Optimizer):
+    def __init__(self, **kwargs):
+        super().__init__("adagrad", **kwargs)
+
+
+class DecayedAdaGrad(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(
+            "decayed_adagrad", ada_rou=rho, ada_epsilon=epsilon, **kwargs
+        )
+
+
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(
+            "adadelta", ada_rou=rho, ada_epsilon=epsilon, **kwargs
+        )
+
+
+class RMSProp(Optimizer):
+    def __init__(self, rho=0.95, epsilon=1e-6, **kwargs):
+        super().__init__(
+            "rmsprop", ada_rou=rho, ada_epsilon=epsilon, **kwargs
+        )

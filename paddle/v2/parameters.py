@@ -1,0 +1,111 @@
+"""paddle.v2.parameters — the numpy-facing parameter pool.
+
+Reference: python/paddle/v2/parameters.py:43 (class Parameters — dict of
+numpy arrays keyed by parameter name), :304/:323 (to_tar/from_tar in the
+reference's tar wire format) and parameters.create(topology) which
+allocates and randomizes every parameter of a topology.
+
+The tar codec is paddle_tpu.trainer.checkpoint's reference-interoperable
+implementation (ParameterConfig protobuf sidecars included), so tars
+written here load in the reference and vice versa.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from paddle_tpu.network import Network
+from paddle_tpu.trainer import checkpoint as _ckpt
+
+from .topology import Topology
+
+__all__ = ["Parameters", "create"]
+
+
+def create(layers, seed: int = 0):
+    """Allocate + randomize the parameters of the topology reaching
+    `layers` (reference parameters.py create())."""
+    topo = layers if isinstance(layers, Topology) else Topology(layers)
+    net = Network(topo.proto())
+    params = net.init_params(jax.random.PRNGKey(seed))
+    pool = Parameters()
+    pool.__param_confs__ = dict(net.param_confs)
+    for name, v in params.items():
+        pool.__params__[name] = np.asarray(v)
+    return pool
+
+
+class Parameters:
+    def __init__(self):
+        self.__params__: dict[str, np.ndarray] = {}
+        self.__param_confs__: dict = {}
+
+    # --- dict surface (parameters.py:43 "plain numpy dict") ---
+    def names(self):
+        return list(self.__params__)
+
+    def keys(self):
+        return self.names()
+
+    def has_key(self, key):
+        return key in self.__params__
+
+    def __contains__(self, key):
+        return key in self.__params__
+
+    def __iter__(self):
+        return iter(self.__params__)
+
+    def __len__(self):
+        return len(self.__params__)
+
+    def get(self, parameter_name):
+        return self.__getitem__(parameter_name)
+
+    def __getitem__(self, key):
+        return self.__params__[key]
+
+    def set(self, parameter_name, value):
+        self.__setitem__(parameter_name, value)
+
+    def __setitem__(self, key, value):
+        value = np.asarray(value, np.float32)
+        if key in self.__params__:
+            have = self.__params__[key].shape
+            if int(np.prod(have)) != int(np.prod(value.shape)):
+                raise ValueError(
+                    f"parameter {key!r} expects {have} "
+                    f"({int(np.prod(have))} elems), got {value.shape}"
+                )
+            value = value.reshape(have)
+        self.__params__[key] = value
+
+    def get_shape(self, key):
+        return tuple(self.__params__[key].shape)
+
+    # --- checkpoint (parameters.py:304 to_tar, :323 from_tar) ---
+    def to_tar(self, f):
+        _ckpt.to_tar(f, self.__params__, self.__param_confs__ or None)
+
+    @staticmethod
+    def from_tar(f) -> "Parameters":
+        pool = Parameters()
+        for name, v in _ckpt.from_tar(f).items():
+            pool.__params__[name] = np.asarray(v, np.float32)
+        return pool
+
+    def init_from_tar(self, f):
+        """Overwrite matching parameters from a tar (reference
+        init_from_tar: only names present in this pool are applied)."""
+        for name, v in _ckpt.from_tar(f).items():
+            if name in self.__params__:
+                self.__setitem__(name, v)
+
+    # --- jax bridge (internal; replaces append_gradient_machine) ---
+    def _to_device(self) -> dict:
+        return {k: jax.numpy.asarray(v) for k, v in self.__params__.items()}
+
+    def _sync_from(self, params: dict):
+        for k, v in params.items():
+            self.__params__[k] = np.asarray(v)

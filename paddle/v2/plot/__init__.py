@@ -1,0 +1,8 @@
+"""paddle.v2.plot — training-curve plotting.
+
+Reference: python/paddle/v2/plot/. Backed by paddle_tpu.plot.
+"""
+
+from paddle_tpu.plot import PlotData, Ploter
+
+__all__ = ["Ploter", "PlotData"]

@@ -1,0 +1,16 @@
+"""paddle.v2.pooling — v2 names for pooling types.
+
+Reference: python/paddle/v2/pooling.py (Max = MaxPooling, ...).
+"""
+
+from paddle_tpu.compat.config_parser import (
+    AvgPooling as Avg,
+    MaxPooling as Max,
+    SqrtAvgPooling as SqrtAvg,
+    SumPooling as Sum,
+)
+
+CudnnMax = Max
+CudnnAvg = Avg
+
+__all__ = ["Max", "Avg", "Sum", "SqrtAvg", "CudnnMax", "CudnnAvg"]

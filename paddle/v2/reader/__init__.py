@@ -1,0 +1,37 @@
+"""paddle.v2.reader — functional reader combinators.
+
+Reference: python/paddle/v2/reader/decorator.py:26-292 and creator.py.
+Backed by paddle_tpu.data.reader (same combinator semantics).
+"""
+
+from paddle_tpu.data.reader import (
+    ComposeNotAligned,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    shuffle,
+)
+
+from . import creator
+
+
+def xmap_readers(mapper, reader, process_num=1, buffer_size=None, order=False):
+    """Reference decorator.xmap_readers: map `mapper` over the reader
+    with worker processes. TPU-side the data path is already
+    prefetched natively (native/src/recordio.cc), so this is a
+    semantically-equal serial map."""
+
+    def new_reader():
+        for sample in reader():
+            yield mapper(sample)
+
+    return new_reader
+
+
+__all__ = [
+    "map_readers", "buffered", "compose", "chain", "shuffle",
+    "ComposeNotAligned", "firstn", "xmap_readers", "cache", "creator",
+]

@@ -1,0 +1,8 @@
+"""paddle.v2.reader.creator — readers from arrays/files.
+
+Reference: python/paddle/v2/reader/creator.py (np_array, text_file).
+"""
+
+from paddle_tpu.data.reader import np_array, text_file
+
+__all__ = ["np_array", "text_file"]

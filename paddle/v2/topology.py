@@ -1,0 +1,120 @@
+"""Topology — extract the sub-graph reaching a set of output layers.
+
+Reference: python/paddle/v2/topology.py:25 (class Topology over the
+parsed ModelConfig proto) and layer.py __get_used_layers__ pruning.
+Here the ambient graph is a paddle_tpu ModelConf under construction;
+Topology computes the ancestor closure of the requested outputs (plus
+extra_layers), keeps declaration order, carries the recurrent-group
+sub-models whose layers intersect the closure, and exposes the
+data-layer types for DataFeeder.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from paddle_tpu.core.config import ModelConf
+
+from . import config_base
+
+
+def _as_names(layers):
+    if layers is None:
+        return []
+    if not isinstance(layers, (list, tuple)):
+        layers = [layers]
+    return [getattr(x, "name", x) for x in layers]
+
+
+class Topology:
+    def __init__(self, layers, extra_layers=None, with_evaluators=True):
+        self.output_names = _as_names(layers)
+        if not self.output_names:
+            raise ValueError("Topology needs at least one output layer")
+        extra = _as_names(extra_layers)
+        g = config_base.global_graph()
+        src = g.conf
+
+        by_name = {lc.name: lc for lc in src.layers}
+        # extra outputs ('producer@arg') resolve to their producer
+        def resolve(n):
+            return n.split("@")[0] if n not in by_name and "@" in n else n
+
+        keep = set()
+        frontier = [resolve(n) for n in self.output_names + extra]
+        # evaluator inputs keep their layers alive too (reference:
+        # __get_used_layers__ walks evaluator inputs); inference
+        # topologies skip them so test-only label slots don't become
+        # required feeds
+        if with_evaluators:
+            for ev in config_base.EVALUATORS:
+                for k in ("input", "label", "query_id"):
+                    if k in ev:
+                        frontier.append(resolve(ev[k]))
+        while frontier:
+            n = frontier.pop()
+            if n in keep:
+                continue
+            if n not in by_name:
+                raise KeyError(f"layer {n!r} not found in the config graph")
+            keep.add(n)
+            frontier.extend(
+                resolve(i) for i in by_name[n].input_names()
+            )
+            # a layer inside a recurrent group pulls in the whole group
+            for sm in src.sub_models:
+                if n in sm.layer_names:
+                    frontier.extend(sm.layer_names)
+                    for link in list(sm.in_links) + list(sm.out_links):
+                        frontier.append(resolve(link["layer_name"]))
+                    for mem in sm.memories:
+                        for k in ("layer_name", "link_name", "boot_layer_name"):
+                            v = mem.get(k)
+                            if v:
+                                frontier.append(resolve(v))
+
+        conf = ModelConf(
+            layers=[copy.deepcopy(lc) for lc in src.layers if lc.name in keep],
+            sub_models=[
+                copy.deepcopy(sm)
+                for sm in src.sub_models
+                if any(n in keep for n in sm.layer_names)
+            ],
+            output_layer_names=list(self.output_names),
+        )
+        conf.input_layer_names = [
+            lc.name for lc in conf.layers if lc.type == "data"
+        ]
+        self.conf = conf
+        self.evaluator_confs = [
+            ev
+            for ev in config_base.EVALUATORS
+            if all(
+                resolve(ev[k]) in keep
+                for k in ("input", "label", "query_id")
+                if k in ev
+            )
+        ]
+
+    def proto(self) -> ModelConf:
+        """The pruned ModelConf (the analogue of topology.proto())."""
+        return self.conf
+
+    def data_type(self):
+        """[(data_layer_name, InputType)] in declaration order
+        (reference topology.py data_type())."""
+        out = []
+        for lc in self.conf.layers:
+            if lc.type != "data":
+                continue
+            t = config_base.DATA_TYPES.get(lc.name)
+            if t is None:
+                raise ValueError(
+                    f"data layer {lc.name!r} has no v2 data type — declare "
+                    f"it with paddle.v2.layer.data(name=..., type=...)"
+                )
+            out.append((lc.name, t))
+        return out
+
+    def data_layers(self):
+        return [lc.name for lc in self.conf.layers if lc.type == "data"]

@@ -1,0 +1,159 @@
+"""paddle.v2.trainer — the event-driven SGD training loop.
+
+Reference: python/paddle/v2/trainer.py:24 (class SGD) and :145-176 (the
+pass/batch loop: BeginPass, per batch BeginIteration -> feed ->
+forwardBackward+update -> eval -> EndIteration(cost, batch evaluator),
+per pass test/EndPass(pass evaluator)). The SWIG GradientMachine +
+ParameterUpdater pair collapses into one paddle_tpu jit-compiled
+TrainStep; `is_local=False` needs no pserver processes — the same
+program runs SPMD over the device mesh.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.evaluators import create_evaluator
+from paddle_tpu.trainer.trainer import SGD as _Engine
+
+from . import event as v2_event
+from . import optimizer as v2_optimizer
+from . import parameters as v2_parameters
+from .data_feeder import DataFeeder
+from .topology import Topology
+
+__all__ = ["SGD"]
+
+
+def default_event_handler(event):
+    pass
+
+
+class SGD:
+    def __init__(
+        self,
+        cost,
+        parameters,
+        update_equation,
+        extra_layers=None,
+        is_local=True,
+        pserver_spec=None,
+        use_etcd=True,
+    ):
+        if not isinstance(parameters, v2_parameters.Parameters):
+            raise TypeError("parameters should be parameters")
+        if not isinstance(update_equation, v2_optimizer.Optimizer):
+            raise TypeError(
+                "update equation parameter must be "
+                "paddle.v2.optimizer.Optimizer"
+            )
+        topology = Topology(cost, extra_layers=extra_layers)
+        self.__optimizer__ = update_equation
+        self.__topology__ = topology
+        self.__parameters__ = parameters
+        self.__data_types__ = topology.data_type()
+        self.__engine__ = _Engine(
+            topology.proto(),
+            update_equation.conf,
+            evaluators=topology.evaluator_confs,
+            params=parameters._to_device(),
+        )
+
+    def __make_feeder__(self, feeding):
+        if feeding is None:
+            feeding = {name: i for i, (name, _) in
+                       enumerate(self.__data_types__)}
+        elif isinstance(feeding, (list, tuple)):
+            feeding = {name: i for i, name in enumerate(feeding)}
+        types = dict(self.__data_types__)
+        used = {n for n, _ in self.__data_types__}
+        return DataFeeder(
+            {k: v for k, v in feeding.items() if k in used}, types
+        )
+
+    def save_parameter_to_tar(self, f):
+        self.__parameters__._sync_from(self.__engine__.params)
+        self.__parameters__.__param_confs__ = dict(
+            self.__engine__.net.param_confs
+        )
+        self.__parameters__.to_tar(f)
+
+    def train(self, reader, num_passes=1, event_handler=None, feeding=None):
+        """Train num_passes over the batched reader (reference
+        trainer.py:110,145-176)."""
+        if event_handler is None:
+            event_handler = default_event_handler
+        if not callable(reader):
+            raise TypeError(
+                "train reader should be a function returning an iterator"
+            )
+        if not callable(event_handler):
+            raise TypeError("event handler should be a function")
+        feeder = self.__make_feeder__(feeding)
+        engine = self.__engine__
+        for pass_id in range(num_passes):
+            event_handler(v2_event.BeginPass(pass_id))
+            pass_evals = [
+                create_evaluator(c) for c in self.__topology__.evaluator_confs
+            ]
+            for batch_id, data_batch in enumerate(reader()):
+                event_handler(
+                    v2_event.BeginIteration(pass_id=pass_id,
+                                            batch_id=batch_id)
+                )
+                feed = feeder(data_batch)
+                step_rng = _rng.split_for_step(
+                    engine.step_key, engine.global_step
+                )
+                (
+                    engine.params,
+                    engine.opt_state,
+                    engine.state,
+                    loss,
+                    outs,
+                ) = engine.step_fn(
+                    engine.params, engine.opt_state, engine.state, feed,
+                    engine.global_step, step_rng,
+                )
+                engine.global_step += 1
+                batch_results = {}
+                for conf, ev in zip(
+                    self.__topology__.evaluator_confs, pass_evals
+                ):
+                    ev.add_batch(outs, feed)
+                    batch_ev = create_evaluator(conf)
+                    batch_ev.add_batch(outs, feed)
+                    batch_results[batch_ev.name] = batch_ev.result()
+                event_handler(
+                    v2_event.EndIteration(
+                        pass_id=pass_id,
+                        batch_id=batch_id,
+                        cost=float(loss),
+                        evaluator=v2_event.EvalResults(batch_results),
+                    )
+                )
+            self.__parameters__._sync_from(engine.params)
+            event_handler(
+                v2_event.EndPass(
+                    pass_id,
+                    evaluator=v2_event.EvalResults(
+                        {ev.name: ev.result() for ev in pass_evals}
+                    ),
+                )
+            )
+
+    def test(self, reader, feeding=None):
+        """Evaluate over the batched reader; returns TestResult
+        (reference trainer.py:178-205)."""
+        feeder = self.__make_feeder__(feeding)
+        res = self.__engine__.test(reader, feeder)
+        return v2_event.TestResult(
+            evaluator=v2_event.EvalResults(res["evaluators"]),
+            cost=res["cost"],
+        )
+
+
+def __check_train_args__(reader, event_handler, **kwargs):
+    if not callable(reader):
+        raise TypeError("train_data_reader should be a function")
+    if not callable(event_handler):
+        raise TypeError("event handler should be a function")

@@ -529,6 +529,22 @@ class TrainerConfig:
     args: dict
     evaluators: list = field(default_factory=list)
 
+    # -- the reference TrainerConfig proto surface the api drivers use
+    #    (proto/TrainerConfig.proto; v1_api_demo/quick_start/api_train.py:80-84)
+    @property
+    def model_config(self):
+        return self.model
+
+    @property
+    def opt_config(self):
+        return self.opt
+
+    def ClearField(self, name: str):
+        if name in ("data_config", "test_data_config"):
+            self.data_sources = None
+        elif hasattr(self, name):
+            setattr(self, name, None)
+
 
 def _parse_args(config_args) -> dict:
     if not config_args:

@@ -53,6 +53,8 @@ __all__ = [
     "seq_reshape_layer",
     "sub_seq_layer",
     "mixed_layer",
+    "dotmul_operator",
+    "multi_binary_label_cross_entropy",
     "full_matrix_projection",
     "trans_full_matrix_projection",
     "identity_projection",
@@ -87,6 +89,8 @@ __all__ = [
     "classification_cost",
     "cross_entropy",
     "square_error_cost",
+    "mse_cost",
+    "regression_cost",
     "rank_cost",
     "sum_cost",
     "prelu_layer",
@@ -97,6 +101,7 @@ __all__ = [
     "simple_lstm",
     "simple_gru",
     "bidirectional_lstm",
+    "sequence_conv_pool",
     "simple_img_conv_pool",
     "img_conv_group",
     "small_vgg",
@@ -223,10 +228,24 @@ def data_layer(name, size, height=None, width=None, depth=None,
                     has_subseq=has_subseq)
 
 
+
+def _bias(bias_attr):
+    """bias_attr may be bool or a ParamAttr/ParameterConf carrying a
+    shared name + initializer (the VAE config names every bias so
+    copy_shared_parameters can match them across machines)."""
+    if isinstance(bias_attr, ParameterConf):
+        return True, bias_attr
+    return bool(bias_attr), None
+
+
 def fc_layer(input, size, act=None, name=None, bias_attr=True,
              param_attr=None, layer_attr=None, **_):
-    out = dsl.fc(*_many(input), size=size, name=name, act=_act(act),
-                 bias=bool(bias_attr), param=param_attr)
+    # reference default activation for fc is tanh (layers.py:949
+    # wrap_act_default); an explicit LinearActivation() stays linear
+    b, bp = _bias(bias_attr)
+    out = dsl.fc(*_many(input), size=size, name=name,
+                 act=_act_or(act, "tanh"),
+                 bias=b, bias_param=bp, param=param_attr)
     return _apply_layer_attr(out, layer_attr)
 
 
@@ -275,7 +294,22 @@ def dropout_layer(input, dropout_rate, name=None, **_):
 def img_conv_layer(input, filter_size, num_filters, stride=1, padding=0,
                    groups=1, dilation=1, act=None, name=None,
                    num_channels=None, bias_attr=True, param_attr=None,
-                   **_):
+                   trans=False, **_):
+    if trans:
+        # deconvolution (layers.py img_conv_layer trans=True -> exconvt,
+        # the GAN generator's upsampling path)
+        assert groups == 1 and dilation == 1, (
+            "exconvt compat supports groups=1, dilation=1"
+        )
+        b, bp = _bias(bias_attr)
+        out = dsl.conv_trans(_one(input), num_filters, filter_size,
+                             stride=stride, padding=padding, name=name,
+                             act=_act_or(act, "relu"), bias=b,
+                             bias_param=bp, param=param_attr)
+        if num_channels:
+            lc = out.builder.conf.layer(out.name)
+            lc.attrs["num_channels"] = num_channels
+        return out
     return dsl.conv(_one(input), num_filters, filter_size, stride=stride,
                     padding=padding, groups=groups, dilation=dilation,
                     name=name, act=_act_or(act, "relu"),
@@ -389,9 +423,83 @@ def sub_seq_layer(input, offsets, sizes, name=None, **_):
     return dsl.sub_seq(_one(input), offsets, sizes, name=name)
 
 
-def mixed_layer(size, input, act=None, name=None, bias_attr=True, **_):
+class _MixedLayerBuilder:
+    """`with mixed_layer() as m: m += projection` — the v1 helper's
+    context-manager form (layers.py mixed_layer docstring). Terms are
+    collected via `+=` and the real mixed layer is materialized on
+    exit; afterwards the builder proxies the finished LayerRef (its
+    .name), so it is usable anywhere a layer handle is."""
+
+    def __init__(self, size, act, name, bias_attr):
+        self._spec = (size, act, name, bias_attr)
+        self._terms = []
+        self._ref = None
+
+    def __iadd__(self, term):
+        self._terms.append(term)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            return False
+        size, act, name, bias_attr = self._spec
+        self._ref = dsl.mixed(size, self._terms, name=name, act=_act(act),
+                              bias=bool(bias_attr))
+        return False
+
+    @property
+    def name(self):
+        assert self._ref is not None, "mixed_layer context not exited yet"
+        return self._ref.name
+
+    @property
+    def builder(self):
+        return self._ref.builder
+
+    # arithmetic works like any layer handle (layer_math patches these
+    # onto LayerRef; delegate to the finished ref)
+    def __add__(self, other):
+        return self._ref + other
+
+    def __radd__(self, other):
+        return self._ref.__radd__(other)
+
+    def __sub__(self, other):
+        return self._ref - other
+
+    def __rsub__(self, other):
+        return self._ref.__rsub__(other)
+
+    def __mul__(self, other):
+        return self._ref * other
+
+    def __rmul__(self, other):
+        return self._ref.__rmul__(other)
+
+
+def mixed_layer(size=0, input=None, act=None, name=None, bias_attr=False, **_):
+    if input is None:
+        return _MixedLayerBuilder(size, act, name, bias_attr)
     return dsl.mixed(size, _many(input), name=name, act=_act(act),
                      bias=bool(bias_attr))
+
+
+def dotmul_operator(a, b=None, scale=1.0, **_):
+    """Mixed-layer elementwise-product operator (layers.py
+    dotmul_operator; DotMulOperator.cpp). An operator term is a plain
+    summand, so it materializes as an eltmul layer fed back through an
+    identity projection."""
+    x = dsl.eltmul(_one(a), _one(b if b is not None else a), scale=scale)
+    return (x, "identity")
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0, **_):
+    return dsl.multi_binary_label_cross_entropy(
+        _one(input), _one(label), name=name, coeff=coeff
+    )
 
 
 # ---- projections for mixed_layer (trainer_config_helpers/layers.py
@@ -412,6 +520,8 @@ def identity_projection(input, offset=None, **_):
 
 
 def dotmul_projection(input, param_attr=None, **_):
+    if param_attr is not None:
+        return (_one(input), "dotmul", {"param": param_attr})
     return (_one(input), "dotmul")
 
 
@@ -434,7 +544,7 @@ def table_projection(input, size=0, param_attr=None, **_):
 def context_projection(input, context_len, context_start=None, **_):
     start = (-(context_len // 2)) if context_start is None else context_start
     return (_one(input), "context",
-            {"context_len": context_len, "context_start": start})
+            {"context_length": context_len, "context_start": start})
 
 
 def tensor_layer(a, b, size, act=None, name=None, bias_attr=True, **_):
@@ -600,6 +710,11 @@ def square_error_cost(input, label, name=None, coeff=1.0, **_):
     return dsl.square_error(input, label, name=name, coeff=coeff)
 
 
+# reference layers.py:4042 mse_cost with alias regression_cost (:4077)
+mse_cost = square_error_cost
+regression_cost = square_error_cost
+
+
 def rank_cost(left, right, label, name=None, coeff=1.0, **_):
     return dsl.rank_cost(left, right, label, name=name, coeff=coeff)
 
@@ -656,6 +771,38 @@ def bidirectional_lstm(input, size, name=None, return_seq=False, **_):
     bwd = dsl.simple_lstm(x, size, name=(name or "bilstm") + "_bwd",
                           reversed=True)
     return dsl.concat(dsl.last_seq(fwd), dsl.first_seq(bwd), name=name)
+
+
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None,
+                       context_proj_layer_name=None,
+                       context_proj_param_attr=False,
+                       fc_layer_name=None, fc_param_attr=None,
+                       fc_bias_attr=None, fc_act=None,
+                       pool_bias_attr=None, **_):
+    """Text conv-pool: context projection -> fc -> sequence pooling
+    (networks.py:41 sequence_conv_pool — the quick_start CNN)."""
+    x = _one(input)
+    context_proj_layer_name = (
+        context_proj_layer_name or f"{name}_conv_proj"
+    )
+    with mixed_layer(
+        name=context_proj_layer_name,
+        size=x.size * context_len,
+        act=LinearActivation(),
+    ) as m:
+        m += context_projection(
+            x, context_len=context_len, context_start=context_start
+        )
+    fl = fc_layer(
+        name=fc_layer_name or f"{name}_conv_fc",
+        input=m,
+        size=hidden_size,
+        act=fc_act or TanhActivation(),
+        param_attr=fc_param_attr,
+        bias_attr=fc_bias_attr if fc_bias_attr is not None else True,
+    )
+    return pooling_layer(name=name, input=fl, pooling_type=pool_type)
 
 
 def simple_img_conv_pool(input, filter_size, num_filters, pool_size,

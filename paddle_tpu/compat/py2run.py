@@ -1,0 +1,86 @@
+"""Execute an UNMODIFIED python-2-era reference script under python 3.
+
+Reference drivers (v1_api_demo/quick_start/api_train.py,
+gan/gan_trainer.py, vae/vae_train.py, ...) are python 2: print
+statements, xrange, cPickle. The file on disk is never touched — the
+source is mechanically converted at load time (lib2to3 fixers) exactly
+like the config path injects xrange (compat/config_parser.py:566), then
+exec'd with __name__ == '__main__'.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import warnings
+
+# NOTE: no "xrange" fixer — xrange is injected into the exec globals
+# instead (run_py2_script below), so callers can substitute a bounded
+# range to keep demo training loops test-sized without editing the file.
+_FIXES = [
+    "print", "except", "imports", "has_key", "dict", "raise",
+    "ne", "numliterals", "funcattrs", "itertools", "itertools_imports",
+    "reduce", "basestring", "unicode", "zip", "map", "filter",
+]
+
+
+def to_py3(src: str, name: str = "<py2 script>") -> str:
+    """Mechanical py2 -> py3 source conversion (no-op if already py3)."""
+    try:
+        compile(src, name, "exec")
+        return src
+    except SyntaxError:
+        pass
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # lib2to3 deprecation
+        from lib2to3.refactor import RefactoringTool
+
+        rt = RefactoringTool([f"lib2to3.fixes.fix_{f}" for f in _FIXES])
+        if not src.endswith("\n"):
+            src += "\n"
+        return str(rt.refactor_string(src, name))
+
+
+def load_py2_module(path: str, name: str, extra_globals=None):
+    """Import a python-2-era helper module (e.g. the mnist demo's
+    mnist_util.py) with the same mechanical conversion + xrange
+    injection, registering it in sys.modules so the driver script's
+    own `import` resolves to it."""
+    import types
+
+    with open(path) as f:
+        src = to_py3(f.read(), path)
+    mod = types.ModuleType(name)
+    mod.__file__ = os.path.abspath(path)
+    mod.__dict__["xrange"] = range
+    if extra_globals:
+        mod.__dict__.update(extra_globals)
+    exec(compile(src, path, "exec"), mod.__dict__)
+    sys.modules[name] = mod
+    return mod
+
+
+def run_py2_script(path: str, argv=(), extra_globals=None, run_name="__main__"):
+    """Exec the script at `path` as __main__ with sys.argv set.
+
+    Returns the script's global namespace (so tests can call into it)."""
+    with open(path) as f:
+        src = to_py3(f.read(), path)
+    code = compile(src, path, "exec")
+    g = {
+        "__name__": run_name,
+        "__file__": os.path.abspath(path),
+        "xrange": range,
+    }
+    if extra_globals:
+        g.update(extra_globals)
+    old_argv = sys.argv
+    old_path = list(sys.path)
+    sys.argv = [path] + list(argv)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+    try:
+        exec(code, g)
+    finally:
+        sys.argv = old_argv
+        sys.path[:] = old_path
+    return g

@@ -1,0 +1,694 @@
+"""The `py_paddle.swig_paddle` API surface, TPU-native.
+
+Reference: paddle/api/PaddleAPI.h:103,244,402 + paddle/api/Paddle.i
+(the SWIG module the reference's API-driven demo drivers import:
+v1_api_demo/quick_start/api_train.py:17, gan/gan_trainer.py:24,
+vae/vae_train.py:24). Slot-indexed Arguments of Matrix/IVector wrap
+numpy; GradientMachine/Trainer execute as jit-compiled paddle_tpu
+Network/TrainStep programs instead of the C++ gserver stack.
+
+Covered (what the four reference drivers exercise): initPaddle,
+Matrix/Vector/IVector numpy bridges, Arguments with value/id slots and
+sequence start positions, GradientMachine.createFromConfigProto /
+forward / forwardTest / forwardBackward / parameter handles with
+PARAMETER_VALUE buffers (copyFrom/copyToNumpyArray — the GAN's
+copy_shared_parameters), loadParameters/randParameters, and
+Trainer.create with the startTrain/startTrainPass/trainOneDataBatch/
+finishTrainPass/startTestPeriod/testOneDataBatch/finishTestPeriod
+loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import numpy as np
+
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core import rng as _rng
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+from paddle_tpu.parallel.dp import TrainStep
+
+log = logging.getLogger("paddle_tpu.api")
+
+# --- constants (api/PaddleAPI.h enums) ---
+PARAMETER_VALUE = 0
+PARAMETER_GRADIENT = 1
+PARAMETER_MOMENTUM = 2
+PASS_TRAIN = 0
+PASS_TEST = 1
+PASS_GC = 2
+CREATE_MODE_NORMAL = 0
+CREATE_MODE_SGD_SPARSE_CPU_TRAINING = 3
+NO_SPARSE_ID = -1
+
+
+def initPaddle(*args):
+    """api.initPaddle('--use_gpu=0', ...) — gflags-style strings
+    (api/Paddle.i initPaddle). Flags with a paddle_tpu equivalent are
+    applied; device-model-specific ones are accepted and ignored."""
+    mapped = {
+        "seed": ("seed", int),
+        "log_period": ("log_period", int),
+        "show_parameter_stats_period": ("show_parameter_stats_period", int),
+        "beam_size": ("beam_size", int),
+        "start_pass": ("start_pass", int),
+    }
+    for a in args:
+        if not a.startswith("--"):
+            continue
+        k, _, v = a[2:].partition("=")
+        if k in mapped:
+            name, cast = mapped[k]
+            _flags.set_flag(name, cast(v))
+
+
+def _as2d(a: np.ndarray) -> np.ndarray:
+    a = np.asarray(a)
+    return a.reshape(a.shape[0], -1) if a.ndim != 2 else a
+
+
+class Matrix:
+    """Dense host matrix (api/PaddleAPI.h:103 Matrix; numpy bridge
+    api/Paddle.i:142-165)."""
+
+    def __init__(self, array):
+        self._a = _as2d(np.asarray(array, np.float32))
+
+    @classmethod
+    def createDenseFromNumpy(cls, a, copy=True):
+        return cls(np.array(a, np.float32, copy=copy))
+
+    @classmethod
+    def createDense(cls, data, height, width):
+        return cls(np.asarray(data, np.float32).reshape(height, width))
+
+    @classmethod
+    def createZero(cls, height, width):
+        return cls(np.zeros((height, width), np.float32))
+
+    def copyToNumpyMat(self) -> np.ndarray:
+        return np.array(self._a)
+
+    toNumpyMat = copyToNumpyMat
+
+    def getData(self):
+        return self._a.ravel()
+
+    def getHeight(self):
+        return self._a.shape[0]
+
+    def getWidth(self):
+        return self._a.shape[1]
+
+
+class _VectorBase:
+    _dtype = np.float32
+
+    def __init__(self, array):
+        self._a = np.asarray(array, self._dtype).ravel()
+
+    @classmethod
+    def createVectorFromNumpy(cls, a, copy=True):
+        return cls(np.array(a, cls._dtype, copy=copy))
+
+    @classmethod
+    def create(cls, data):
+        return cls(np.asarray(data, cls._dtype))
+
+    @classmethod
+    def createZero(cls, n):
+        return cls(np.zeros(n, cls._dtype))
+
+    def copyToNumpyArray(self) -> np.ndarray:
+        return np.array(self._a)
+
+    toNumpyArray = copyToNumpyArray
+
+    def __len__(self):
+        return int(self._a.size)
+
+    def copyFrom(self, other):
+        self._a = np.array(other._a if isinstance(other, _VectorBase)
+                           else other, self._dtype).ravel()
+
+
+class Vector(_VectorBase):
+    _dtype = np.float32
+
+
+class IVector(_VectorBase):
+    _dtype = np.int32
+
+
+class Arguments:
+    """Slot-indexed in/out arguments (api/PaddleAPI.h:244 Arguments,
+    parameter/Argument.h:29). A slot is a dense Matrix, an id IVector,
+    or a prepared paddle_tpu Arg (what DataProviderConverter emits);
+    sequence slots carry start positions exactly like the reference
+    (Argument.sequenceStartPositions)."""
+
+    def __init__(self, n_slots: int = 0):
+        self._slots = [dict() for _ in range(n_slots)]
+
+    @classmethod
+    def createArguments(cls, n):
+        return cls(n)
+
+    def resize(self, n):
+        while len(self._slots) < n:
+            self._slots.append({})
+        del self._slots[n:]
+
+    def getSlotNum(self):
+        return len(self._slots)
+
+    def _slot(self, i):
+        if i >= len(self._slots):
+            self.resize(i + 1)
+        return self._slots[i]
+
+    # --- setters ---
+    def setSlotValue(self, i, m: Matrix):
+        self._slot(i)["value"] = m
+
+    def setSlotIds(self, i, v: IVector):
+        self._slot(i)["ids"] = v
+
+    def setSlotSequenceStartPositions(self, i, v: IVector):
+        self._slot(i)["seq_starts"] = v
+
+    def setSlotSubSequenceStartPositions(self, i, v: IVector):
+        self._slot(i)["subseq_starts"] = v
+
+    def _setSlotArg(self, i, arg: Arg):
+        self._slot(i)["arg"] = arg
+
+    # --- getters ---
+    def getSlotValue(self, i) -> Matrix:
+        s = self._slots[i]
+        if "value" in s:
+            return s["value"]
+        return Matrix(_flatten_arg_value(s["arg"]))
+
+    def getSlotIds(self, i) -> IVector:
+        s = self._slots[i]
+        if "ids" in s:
+            return s["ids"]
+        return IVector(_flatten_arg_ids(s["arg"]))
+
+    def getSlotSequenceStartPositions(self, i) -> IVector:
+        s = self._slots[i]
+        if "seq_starts" in s:
+            return s["seq_starts"]
+        a = s["arg"]
+        lens = np.asarray(a.seq_lens)
+        return IVector(np.concatenate([[0], np.cumsum(lens)]))
+
+    def sum(self) -> float:
+        """Total of slot 0's values (api Arguments::sum — the cost
+        accumulator the v2 loop divides by batch size)."""
+        return float(np.sum(self.getSlotValue(0).copyToNumpyMat()))
+
+    # --- feed conversion (internal) ---
+    def _to_arg(self, i) -> Arg:
+        s = self._slots[i]
+        if "arg" in s:
+            return s["arg"]
+        starts = s.get("seq_starts")
+        if "ids" in s:
+            ids = s["ids"].copyToNumpyArray()
+            if starts is None:
+                return Arg(ids=ids)
+            st = starts.copyToNumpyArray()
+            lens = np.diff(st).astype(np.int32)
+            b, t = len(lens), int(lens.max(initial=1))
+            out = np.zeros((b, t), np.int32)
+            for j, (lo, hi) in enumerate(zip(st[:-1], st[1:])):
+                out[j, : hi - lo] = ids[lo:hi]
+            return Arg(ids=out, seq_lens=lens)
+        v = s["value"].copyToNumpyMat()
+        if starts is None:
+            return Arg(value=v)
+        st = starts.copyToNumpyArray()
+        lens = np.diff(st).astype(np.int32)
+        b, t = len(lens), int(lens.max(initial=1))
+        out = np.zeros((b, t, v.shape[1]), np.float32)
+        for j, (lo, hi) in enumerate(zip(st[:-1], st[1:])):
+            out[j, : hi - lo] = v[lo:hi]
+        return Arg(value=out, seq_lens=lens)
+
+    def _feed(self, names) -> dict:
+        if len(names) < len(self._slots):
+            raise ValueError(
+                f"{len(self._slots)} slots fed but the network declares "
+                f"only data layers {names}"
+            )
+        return {
+            name: self._to_arg(i)
+            for i, name in enumerate(names[: len(self._slots)])
+        }
+
+
+def _flatten_arg_value(a: Arg) -> np.ndarray:
+    v = np.asarray(a.value)
+    if a.seq_lens is None:
+        return v.reshape(v.shape[0], -1)
+    # sequence output: the reference layout is the padding-free
+    # [sum(T_i), D] stack (Argument.h:84)
+    lens = np.asarray(a.seq_lens)
+    rows = [v[i, : lens[i]].reshape(lens[i], -1) for i in range(len(lens))]
+    return np.concatenate(rows, axis=0) if rows else v.reshape(0, -1)
+
+
+def _flatten_arg_ids(a: Arg) -> np.ndarray:
+    ids = np.asarray(a.ids)
+    if a.seq_lens is None or ids.ndim == 1:
+        return ids.ravel()
+    lens = np.asarray(a.seq_lens)
+    return np.concatenate([ids[i, : lens[i]] for i in range(len(lens))])
+
+
+class ParameterBuffer:
+    """A live view of one parameter buffer (api Vector over
+    Parameter::getBuf). copyFrom writes THROUGH to the owning machine —
+    the GAN driver's copy_shared_parameters depends on that."""
+
+    def __init__(self, gm: "GradientMachine", name: str, kind: int):
+        self._gm = gm
+        self._name = name
+        self._kind = kind
+
+    def _read(self) -> np.ndarray:
+        if self._kind == PARAMETER_GRADIENT:
+            g = self._gm._grads.get(self._name)
+            return np.zeros(self._len(), np.float32) if g is None \
+                else np.asarray(g).ravel()
+        return np.asarray(self._gm.params[self._name]).ravel()
+
+    def _len(self):
+        return int(np.prod(self._gm.net.param_confs[self._name].dims))
+
+    def __len__(self):
+        return self._len()
+
+    def copyToNumpyArray(self):
+        return np.array(self._read(), np.float32)
+
+    def copyFrom(self, other):
+        src = other._read() if isinstance(other, ParameterBuffer) else (
+            other._a if isinstance(other, _VectorBase) else np.asarray(other)
+        )
+        if self._kind != PARAMETER_VALUE:
+            raise ValueError("only PARAMETER_VALUE buffers are writable")
+        shape = self._gm.params[self._name].shape
+        self._gm.params[self._name] = jax.numpy.asarray(
+            np.asarray(src, np.float32).reshape(shape)
+        )
+
+    def copyFromNumpyArray(self, a):
+        self.copyFrom(np.asarray(a, np.float32))
+
+
+class Parameter:
+    def __init__(self, gm: "GradientMachine", name: str):
+        self._gm = gm
+        self._name = name
+
+    def getName(self):
+        return self._name
+
+    def getSize(self):
+        return int(np.prod(self._gm.net.param_confs[self._name].dims))
+
+    def getBuf(self, kind):
+        return ParameterBuffer(self._gm, self._name, kind)
+
+    def setValueUpdated(self):
+        pass  # device copy already happened in ParameterBuffer.copyFrom
+
+    def __len__(self):
+        return self.getSize()
+
+    def getConfig(self):
+        return self._gm.net.param_confs[self._name]
+
+
+class Evaluator:
+    """api.Evaluator over the machine's implied metric set: the
+    reference auto-attaches classification_error to every
+    classification_cost (trainer_config_helpers layers.py
+    classification_cost's evaluator default); eval() accumulates from
+    the machine's last forward."""
+
+    def __init__(self, confs):
+        from paddle_tpu.evaluators import create_evaluator
+
+        self._evals = [create_evaluator(c) for c in confs]
+        self._started = False
+
+    def start(self):
+        for ev in self._evals:
+            ev.start()
+        self._started = True
+
+    def finish(self):
+        self._started = False
+
+    def _add(self, outs, feed):
+        for ev in self._evals:
+            ev.add_batch(outs, feed)
+
+    def getNames(self):
+        return [ev.name for ev in self._evals]
+
+    def getValue(self, name):
+        for ev in self._evals:
+            if ev.name == name:
+                return ev.result()
+        raise KeyError(name)
+
+    def __repr__(self):
+        return " ".join(
+            f"{ev.name}={ev.result()}" for ev in self._evals
+        ) or "<no evaluators>"
+
+
+class GradientMachine:
+    """api/PaddleAPI.h:402 GradientMachine over a jitted Network."""
+
+    def __init__(self, conf, seed: int = 0):
+        self.conf = conf
+        self.net = Network(conf)
+        self.params = self.net.init_params(jax.random.PRNGKey(seed))
+        self.state = self.net.init_state()
+        self._grads: dict = {}
+        self._param_names = sorted(self.net.param_confs)
+        self._fwd_cache: dict = {}
+        self._last = None  # (outs, feed) of the latest forward
+        # implied evaluators (classification_error per classification
+        # cost), what the reference's makeEvaluator materializes
+        self._eval_confs = []
+        for lc in conf.layers:
+            if lc.type == "classification_cost" and len(lc.inputs) >= 2:
+                self._eval_confs.append({
+                    "type": "classification_error",
+                    "name": "classification_error",
+                    "input": lc.inputs[0].name,
+                    "label": lc.inputs[1].name,
+                })
+        self._keep = set(self.net.output_names) | {
+            c["input"] for c in self._eval_confs
+        }
+
+    def makeEvaluator(self) -> Evaluator:
+        return Evaluator(self._eval_confs)
+
+    def eval(self, evaluator: Evaluator):
+        assert self._last is not None, "eval() before any forward"
+        evaluator._add(*self._last)
+
+    @classmethod
+    def createFromConfigProto(cls, conf, mode=CREATE_MODE_NORMAL,
+                              enable_types=None):
+        return cls(conf)
+
+    # --- parameters ---
+    def getParameterSize(self):
+        return len(self._param_names)
+
+    def getParameter(self, i: int) -> Parameter:
+        return Parameter(self, self._param_names[i])
+
+    def getParameters(self):
+        return [Parameter(self, n) for n in self._param_names]
+
+    def getParameterNames(self):
+        return list(self._param_names)
+
+    def getNonStaticParameters(self):
+        return [
+            Parameter(self, n)
+            for n in self._param_names
+            if not getattr(self.net.param_confs[n], "is_static", False)
+        ]
+
+    def randParameters(self, seed: int = 0):
+        self.params = self.net.init_params(jax.random.PRNGKey(seed))
+
+    def loadParameters(self, path: str):
+        """Load from a paddle_tpu checkpoint: a save_dir with pass-*
+        subdirs, one pass dir, or a merged model file
+        (trainer/ParamUtil.h:77-93 loadParameters)."""
+        from paddle_tpu.trainer import checkpoint as ckpt
+
+        if os.path.isfile(path):
+            _, params, state = ckpt.load_merged(path)
+        elif any(n.startswith("pass-") for n in os.listdir(path)):
+            # a save_dir of pass-* checkpoints: latest wins
+            params, _, state, _ = ckpt.load_pass(path, -1)
+        elif os.path.exists(os.path.join(path, "params.npz")):
+            # a single pass-XXXXX dir given directly
+            parent, leaf = os.path.split(path.rstrip("/"))
+            params, _, state, _ = ckpt.load_pass(
+                parent, int(leaf.split("-")[1])
+            )
+        else:
+            raise FileNotFoundError(
+                f"no checkpoint (pass-* dir or merged file) at {path!r}"
+            )
+        self.params = {k: jax.numpy.asarray(v) for k, v in params.items()}
+        if state:
+            self.state = jax.tree_util.tree_map(jax.numpy.asarray, state)
+
+    # --- execution ---
+    def _fwd(self, train: bool):
+        key = ("fwd", train)
+        if key not in self._fwd_cache:
+            keep = self._keep
+
+            def fwd(params, state, feed):
+                outs, _ = self.net.forward(
+                    params, feed, state=state, train=False
+                )
+                return {n: outs[n] for n in keep if n in outs}
+
+            self._fwd_cache[key] = jax.jit(fwd)
+        return self._fwd_cache[key]
+
+    def forward(self, inArgs: Arguments, outArgs: Arguments, passType=None):
+        feed = inArgs._feed(self.net.input_names)
+        outs = self._fwd(passType == PASS_TRAIN)(
+            self.params, self.state, feed
+        )
+        self._last = (outs, feed)
+        outArgs.resize(len(self.net.output_names))
+        for i, n in enumerate(self.net.output_names):
+            a = outs[n]
+            if a.ids is not None and a.value is None:
+                outArgs.setSlotIds(i, IVector(_flatten_arg_ids(a)))
+            else:
+                outArgs.setSlotValue(i, Matrix(_flatten_arg_value(a)))
+            outArgs._slot(i)["arg"] = a
+
+    def forwardTest(self, inArgs: Arguments):
+        """Reference api: returns [{'id': ids, 'value': values}] per
+        output layer (py_paddle util swig_paddle.py forwardTest)."""
+        feed = inArgs._feed(self.net.input_names)
+        outs = self._fwd(False)(self.params, self.state, feed)
+        self._last = (outs, feed)
+        res = []
+        for n in self.net.output_names:
+            a = outs[n]
+            d = {}
+            if a.value is not None:
+                v = _flatten_arg_value(a)
+                d["value"] = v
+                d["id"] = np.argmax(v, axis=-1)
+            if a.ids is not None:
+                d["id"] = _flatten_arg_ids(a)
+            res.append(d)
+        return res
+
+    def forwardBackward(self, inArgs: Arguments, outArgs: Arguments,
+                        passType=None):
+        feed = inArgs._feed(self.net.input_names)
+        if "grad" not in self._fwd_cache:
+            keep = self._keep
+
+            def fb(params, state, feed):
+                (loss, (outs, _)), grads = jax.value_and_grad(
+                    self.net.loss_fn, has_aux=True
+                )(params, feed, state=state, train=True)
+                return loss, grads, {
+                    n: outs[n] for n in keep if n in outs
+                }
+
+            self._fwd_cache["grad"] = jax.jit(fb)
+        loss, grads, outs = self._fwd_cache["grad"](
+            self.params, self.state, feed
+        )
+        self._grads = grads
+        self._last = (outs, feed)
+        outArgs.resize(len(self.net.output_names))
+        for i, n in enumerate(self.net.output_names):
+            outArgs.setSlotValue(i, Matrix(_flatten_arg_value(outs[n])))
+        return float(loss)
+
+    def start(self):
+        pass
+
+    def finish(self):
+        pass
+
+
+class ParameterUpdater:
+    """api/ParameterUpdater.cpp local updater: init(gm), then per batch
+    startBatch -> (gm.forwardBackward) -> update(param)* ->
+    finishBatch. The per-parameter update() calls mark parameters; the
+    sharded optimizer applies once all marked (identical observable
+    result, one fused XLA program). apply/restore/catchUpWith are the
+    parameter-averaging window hooks (ThreadParameterUpdater.h:71)."""
+
+    def __init__(self, opt_conf):
+        self._opt_conf = opt_conf
+        self._gm = None
+        self.global_step = 0
+
+    @classmethod
+    def createLocalUpdater(cls, opt_conf):
+        return cls(opt_conf)
+
+    def init(self, gradient_machine: "GradientMachine"):
+        self._gm = gradient_machine
+        self._opt = create_optimizer(
+            self._opt_conf, gradient_machine.net.param_confs
+        )
+        self._opt_state = self._opt.init_state(gradient_machine.params)
+        self._marked = set()
+        self._apply_fn = jax.jit(
+            lambda g, p, s, i: self._opt.update(g, p, s, i)
+        )
+
+    def startPass(self):
+        pass
+
+    def finishPass(self):
+        pass
+
+    def startBatch(self, batch_size: int):
+        self._marked = set()
+        return PASS_TRAIN
+
+    def update(self, param: "Parameter"):
+        self._marked.add(param.getName())
+
+    def finishBatch(self, cost: float = 0.0):
+        gm = self._gm
+        if self._marked and gm._grads:
+            grads = {
+                k: (v if k in self._marked
+                    else jax.numpy.zeros_like(v))
+                for k, v in gm._grads.items()
+            }
+            gm.params, self._opt_state = self._apply_fn(
+                grads, gm.params, self._opt_state, self.global_step
+            )
+            self.global_step += 1
+
+    # parameter-averaging window hooks — the averager is folded into
+    # the optimizer state here; the explicit swap is a no-op
+    def apply(self):
+        pass
+
+    def restore(self):
+        pass
+
+    def catchUpWith(self):
+        pass
+
+    def getParametersRemote(self, *a, **k):
+        pass
+
+
+class Trainer:
+    """api/PaddleAPI.h Trainer: the startTrain/startTrainPass/
+    trainOneDataBatch loop over a TrainerConfig + GradientMachine
+    (trainer/Trainer.cpp:261 semantics)."""
+
+    def __init__(self, config, gm: GradientMachine):
+        self.config = config
+        self.gm = gm
+        self.opt = create_optimizer(config.opt, gm.net.param_confs)
+        self.opt_state = self.opt.init_state(gm.params)
+        self.step_fn = TrainStep(gm.net, self.opt)
+        self.global_step = 0
+        self._pass = 0
+        self._batch = 0
+        self._test_costs: list = []
+        self._key = _rng.root_key(_flags.get_flag("seed"))
+
+    @classmethod
+    def create(cls, config, gm) -> "Trainer":
+        return cls(config, gm)
+
+    @classmethod
+    def createByCommandLine(cls):
+        raise NotImplementedError(
+            "use Trainer.create(config, gradient_machine)"
+        )
+
+    def startTrain(self):
+        pass
+
+    def finishTrain(self):
+        pass
+
+    def startTrainPass(self):
+        self._batch = 0
+
+    def finishTrainPass(self):
+        log.info("pass %d finished (%d batches)", self._pass, self._batch)
+        self._pass += 1
+
+    def trainOneDataBatch(self, size: int, args: Arguments):
+        feed = args._feed(self.gm.net.input_names)
+        rng = _rng.split_for_step(self._key, self.global_step)
+        (
+            self.gm.params,
+            self.opt_state,
+            self.gm.state,
+            loss,
+            _,
+        ) = self.step_fn(
+            self.gm.params, self.opt_state, self.gm.state, feed,
+            self.global_step, rng,
+        )
+        self.global_step += 1
+        self._batch += 1
+        self._last_cost = float(loss)
+        if self._batch % _flags.get_flag("log_period") == 0:
+            log.info("pass %d batch %d cost %.5f",
+                     self._pass, self._batch, self._last_cost)
+        return self._last_cost
+
+    def getForwardOutput(self):
+        return []
+
+    # --- test period (api Trainer::startTestPeriod) ---
+    def startTestPeriod(self):
+        self._test_costs = []
+
+    def testOneDataBatch(self, size: int, args: Arguments):
+        out = Arguments.createArguments(0)
+        self.gm.forward(args, out, PASS_TEST)
+        self._test_costs.append(out.sum() / max(size, 1))
+        return self._test_costs[-1]
+
+    def finishTestPeriod(self):
+        if self._test_costs:
+            log.info("test cost %.5f", float(np.mean(self._test_costs)))

@@ -23,6 +23,18 @@ class InputType:
     kind: str  # dense | ids | sparse_binary | sparse_float
     dim: tuple  # feature shape
     seq: int  # 0 = none, 1 = sequence, 2 = sub-sequence
+    vocab: int = 0  # ids slots: the value range (v1 slot "dim")
+
+    @property
+    def size(self) -> int:
+        """Layer width this slot feeds (reference InputType.dim: vocab
+        for integer slots, feature dim otherwise)."""
+        if self.kind == "ids":
+            return self.vocab
+        n = 1
+        for d in self.dim:
+            n *= d
+        return n
 
 
 def dense_vector(dim, seq_type=0):
@@ -31,7 +43,7 @@ def dense_vector(dim, seq_type=0):
 
 
 def integer_value(vocab, seq_type=0):
-    return InputType("ids", (1,), seq_type)
+    return InputType("ids", (1,), seq_type, vocab=vocab)
 
 
 def sparse_binary_vector(dim, seq_type=0):

@@ -53,17 +53,46 @@ class LayerRef:
     name: str
     builder: GraphBuilder
 
+    @property
+    def size(self) -> int:
+        """Output width (the reference LayerOutput.size)."""
+        return self.builder.conf.layer(self.name).size
+
     def __add__(self, other: "LayerRef") -> "LayerRef":
         return addto(self, other)
 
 
 _stack: list = []
 
+# layer types whose output width equals input `idx`'s width — stamped
+# onto LayerConf.size at DSL time (see _add)
+_SIZE_PRESERVING = {
+    "addto": 0,
+    "slope_intercept": 0,
+    "eltmul": 0,
+    "clip": 0,
+    "print": 0,
+    "interpolation": 1,
+    "scaling": 1,
+    "power": 1,
+}
+
 
 def current() -> GraphBuilder:
     if not _stack:
         raise RuntimeError("no model() context active")
     return _stack[-1]
+
+
+def _cost_name() -> str:
+    """Default cost-layer name: plain "cost" for the first cost in the
+    graph (what configs and evaluators reference), unique thereafter —
+    multi-cost models (e.g. the VAE's reconstruct + KL terms) must not
+    silently collide."""
+    g = current()
+    if all(lc.name != "cost" for lc in g.conf.layers):
+        return "cost"
+    return g.uniq("cost")
 
 
 @contextlib.contextmanager
@@ -79,7 +108,9 @@ def model():
 def _in(x) -> InputConf:
     if isinstance(x, InputConf):
         return x
-    return InputConf(name=x.name if isinstance(x, LayerRef) else x)
+    # anything with a .name is a layer handle (LayerRef or the v1
+    # compat mixed-layer builder); bare strings are layer names
+    return InputConf(name=getattr(x, "name", x))
 
 
 def _add(type_, inputs, name=None, size=0, act="", bias=True, param=None,
@@ -92,6 +123,14 @@ def _add(type_, inputs, name=None, size=0, act="", bias=True, param=None,
         if param is not None and i == 0 and ic.parameter is None:
             ic.parameter = param
         ins.append(ic)
+    if not size and type_ in _SIZE_PRESERVING and ins:
+        # stamp the width at DSL time (the reference's LayerOutput.size
+        # is always populated; layer arithmetic reads it immediately)
+        idx = min(_SIZE_PRESERVING[type_], len(ins) - 1)
+        try:
+            size = g.conf.layer(ins[idx].name).size
+        except KeyError:
+            pass  # extra-output refs ('x@state') resolve at build time
     lc = LayerConf(
         name=name, type=type_, size=size, inputs=ins, active_type=act,
         bias=bias, bias_parameter=bias_param, drop_rate=drop_rate, attrs=attrs,
@@ -150,7 +189,9 @@ def dropout(x, rate, name=None):
 
 
 def mixed(size, inputs, name=None, act="", bias=True):
-    """inputs: list of (layer, proj, extra_attrs) or InputConf."""
+    """inputs: list of (layer, proj, extra_attrs) or InputConf. An
+    extra-attrs key "param" becomes the edge's ParameterConf (v1
+    projections carry param_attr, e.g. dotmul_projection)."""
     ins = []
     for item in inputs:
         if isinstance(item, tuple):
@@ -158,10 +199,42 @@ def mixed(size, inputs, name=None, act="", bias=True):
             attrs = {"proj": proj}
             if rest:
                 attrs.update(rest[0])
-            ins.append(InputConf(name=layer.name, attrs=attrs))
+            param = attrs.pop("param", None)
+            ins.append(
+                InputConf(name=layer.name, attrs=attrs, parameter=param)
+            )
         else:
             ins.append(_in(item))
+    if not size:
+        # infer at DSL time from size-preserving projections so layer
+        # arithmetic right after this call sees the real width
+        # (reference layers.py mixed_layer size=None inference);
+        # extra-output refs ('x@state') defer to MixedLayer.build
+        g = current()
+        for ic in ins:
+            try:
+                in_size = g.conf.layer(ic.name).size
+            except KeyError:
+                continue
+            inferred = mixed_proj_size(
+                ic.attrs.get("proj", "full_matrix"), in_size, ic.attrs
+            )
+            if inferred:
+                size = inferred
+                break
     return _add("mixed", ins, name=name, size=size, act=act, bias=bias)
+
+
+def mixed_proj_size(proj, in_size, attrs):
+    """Output width a size-preserving mixed-layer projection implies,
+    or None when the projection doesn't determine it (full_matrix et
+    al.). The single source of truth for DSL-time inference above and
+    MixedLayer.build."""
+    if proj in ("identity", "dotmul"):
+        return in_size
+    if proj == "context":
+        return in_size * attrs["context_length"]
+    return None
 
 
 # ---- image ----
@@ -177,9 +250,10 @@ def conv(x, num_filters, filter_size, stride=1, padding=0, groups=1,
 
 
 def conv_trans(x, num_filters, filter_size, stride=1, padding=0, name=None,
-               act="relu", bias=True):
+               act="relu", bias=True, param=None, bias_param=None):
     return _add("exconvt", [x], name=name, size=num_filters, act=act,
-                bias=bias, num_filters=num_filters, filter_size=filter_size,
+                bias=bias, param=param, bias_param=bias_param,
+                num_filters=num_filters, filter_size=filter_size,
                 stride=stride, padding=padding)
 
 
@@ -411,22 +485,22 @@ def recurrent_group(step, inputs, name=None, reversed=False):
 # ---- costs ----
 
 def classification_cost(logits, label, name=None, coeff=1.0):
-    return _add("classification_cost", [logits, label], name=name or "cost",
+    return _add("classification_cost", [logits, label], name=name or _cost_name(),
                 bias=False, coeff=coeff)
 
 
 def cross_entropy(prob, label, name=None, coeff=1.0):
     return _add("multi-class-cross-entropy", [prob, label],
-                name=name or "cost", bias=False, coeff=coeff)
+                name=name or _cost_name(), bias=False, coeff=coeff)
 
 
 def square_error(x, y, name=None, coeff=1.0):
-    return _add("square_error", [x, y], name=name or "cost", bias=False,
+    return _add("square_error", [x, y], name=name or _cost_name(), bias=False,
                 coeff=coeff)
 
 
 def rank_cost(a, b, label, name=None, coeff=1.0):
-    return _add("rank-cost", [a, b, label], name=name or "cost", bias=False,
+    return _add("rank-cost", [a, b, label], name=name or _cost_name(), bias=False,
                 coeff=coeff)
 
 
@@ -483,18 +557,31 @@ def soft_binary_cross_entropy(prob, label, name=None, coeff=1.0):
     cross_entropy_with_selfnorm family; CostLayer.cpp
     SoftBinaryClassCrossEntropy)."""
     return _add("soft_binary_class_cross_entropy", [prob, label],
-                name=name or "cost", bias=False, coeff=coeff)
+                name=name or _cost_name(), bias=False, coeff=coeff)
 
 
 def sum_cost(x, name=None, coeff=1.0):
     """(trainer_config_helpers sum_cost): cost = sum of the input."""
-    return _add("sum_cost", [x], name=name or "cost", bias=False,
+    return _add("sum_cost", [x], name=name or _cost_name(), bias=False,
                 coeff=coeff)
+
+
+def multi_binary_label_cross_entropy(prob, label, name=None, coeff=1.0):
+    """Multi-label binary CE (CostLayer.cpp
+    MultiBinaryLabelCrossEntropy); label is a dense 0/1 matrix."""
+    return _add("multi_binary_label_cross_entropy", [prob, label],
+                name=name or _cost_name(), bias=False, coeff=coeff)
+
+
+def eltmul(a, b, scale=1.0, name=None):
+    """Elementwise product (the reference mixed-layer DotMulOperator,
+    config_parser.py DotMulOperator)."""
+    return _add("eltmul", [a, b], name=name, bias=False, scale=scale)
 
 
 def crf(emission, label, num_tags, name=None, param=None, coeff=1.0):
     """(layers.py crf_layer)."""
-    return _add("crf", [emission, label], name=name or "cost", size=num_tags,
+    return _add("crf", [emission, label], name=name or _cost_name(), size=num_tags,
                 bias=False, param=param, coeff=coeff)
 
 

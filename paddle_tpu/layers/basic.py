@@ -242,7 +242,24 @@ class MixedLayer(Layer):
     reference's projection/operator composition model."""
 
     def build(self, in_specs):
+        from paddle_tpu.dsl import mixed_proj_size
+
         out = self.conf.size
+        if not out:
+            # size omitted: infer from size-preserving projections
+            # (reference layers.py mixed_layer size=None inference)
+            for s, ic in zip(in_specs, self.conf.inputs):
+                inferred = mixed_proj_size(
+                    ic.attrs.get("proj", "full_matrix"), s.size, ic.attrs
+                )
+                if inferred:
+                    out = inferred
+                    break
+            assert out, (
+                f"mixed layer {self.name}: size must be given (no "
+                f"size-preserving projection to infer it from)"
+            )
+            self.conf.size = out
         pcs = {}
         seq = any(s.is_seq for s in in_specs)
         for i, (s, ic) in enumerate(zip(in_specs, self.conf.inputs)):

@@ -27,6 +27,32 @@ def conv_out_size(in_size, filt, stride, pad):
     return (in_size + 2 * pad - filt) // stride + 1
 
 
+def _image_shape(name, s, attrs):
+    """(H, W, C) of the input. Flat inputs (v1 configs declare
+    data_layer(size=H*W*C), and fc outputs feeding the GAN deconv
+    stack are flat) infer a square image from num_channels — the
+    reference config_parser's img_pixels = sqrt(size/channels) rule."""
+    if isinstance(s.dim, tuple) and len(s.dim) == 3:
+        return s.dim
+    size = s.dim if isinstance(s.dim, int) else 1
+    if not isinstance(s.dim, int):
+        for d in s.dim:
+            size *= d
+    c = attrs.get("num_channels")
+    if not c:
+        raise ValueError(
+            f"conv '{name}': flat input of size {size} "
+            "needs num_channels to infer the image shape"
+        )
+    hw = int(round((size / c) ** 0.5))
+    if hw * hw * c != size:
+        raise ValueError(
+            f"conv '{name}': input size {size} is not "
+            f"a square image with {c} channels"
+        )
+    return (hw, hw, c)
+
+
 @LAYERS.register("exconv", "cudnn_conv", "conv")
 class ConvLayer(Layer):
     """2-D convolution. attrs: num_filters (or conf.size used as out dim),
@@ -36,29 +62,7 @@ class ConvLayer(Layer):
     def build(self, in_specs):
         (s,) = in_specs
         a = self.conf.attrs
-        if isinstance(s.dim, tuple) and len(s.dim) == 3:
-            h, w, c = s.dim
-        else:
-            # flat input (v1 configs declare data_layer(size=H*W*C)):
-            # infer a square image from num_channels — the reference
-            # config_parser's img_pixels = sqrt(size/channels) rule
-            size = s.dim if isinstance(s.dim, int) else 1
-            if not isinstance(s.dim, int):
-                for d in s.dim:
-                    size *= d
-            c = a.get("num_channels")
-            if not c:
-                raise ValueError(
-                    f"conv '{self.conf.name}': flat input of size {size} "
-                    "needs num_channels to infer the image shape"
-                )
-            hw = int(round((size / c) ** 0.5))
-            if hw * hw * c != size:
-                raise ValueError(
-                    f"conv '{self.conf.name}': input size {size} is not "
-                    f"a square image with {c} channels"
-                )
-            h = w = hw
+        h, w, c = _image_shape(self.conf.name, s, a)
         fh, fw = _pair(a.get("filter_size", 3))
         sh, sw = _pair(a.get("stride", 1))
         ph, pw = _pair(a.get("padding", 0))
@@ -112,8 +116,8 @@ class ConvTransLayer(Layer):
 
     def build(self, in_specs):
         (s,) = in_specs
-        h, w, c = s.dim
         a = self.conf.attrs
+        h, w, c = _image_shape(self.conf.name, s, a)
         fh, fw = _pair(a.get("filter_size", 3))
         sh, sw = _pair(a.get("stride", 1))
         ph, pw = _pair(a.get("padding", 0))

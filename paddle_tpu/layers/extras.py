@@ -454,3 +454,24 @@ class GetOutputLayer(Layer):
     def forward(self, params, inputs, ctx):
         (x,) = inputs
         return x
+
+
+@LAYERS.register("eltmul")
+class ElementwiseMulLayer(Layer):
+    """Elementwise product a ⊙ b (× scale) — the reference's
+    DotMulOperator mixed-layer term (config_parser.py DotMulOperator,
+    gserver/layers/DotMulOperator.cpp) hoisted to a standalone layer:
+    an operator term is just another summand of the mixed layer, so an
+    identity-projected input is exactly equivalent."""
+
+    def build(self, in_specs):
+        a, b = in_specs
+        assert a.size == b.size, (
+            f"eltmul {self.name}: operand sizes differ ({a.size} vs {b.size})"
+        )
+        return Spec(dim=a.dim, is_seq=a.is_seq or b.is_seq), {}
+
+    def forward(self, params, inputs, ctx):
+        a, b = inputs
+        scale = self.conf.attrs.get("scale", 1.0)
+        return a.with_value(scale * a.value * b.value)

@@ -91,6 +91,36 @@ class Network:
         self.cost_names = [
             n for n in order if getattr(self.layers[n], "is_cost", False)
         ]
+        # Declared outputs built FROM cost layers by layer arithmetic
+        # (e.g. the VAE's `outputs(reconstruct_error(...) + KL_loss(...))`
+        # where the KL term is scaled 0.5× via slope_intercept) are the
+        # training objective themselves: the reference's cost is the sum
+        # of the OUTPUT arguments (TrainerInternal.cpp:135 Argument::sum),
+        # so such an output replaces its cost-layer ancestors in the
+        # loss — counting the unscaled ancestors would mis-weight it.
+        derived = []
+        absorbed = set()
+        for out_name in self.output_names:
+            out_name = self._extra_producer.get(out_name, out_name)
+            if getattr(self.layers.get(out_name), "is_cost", False):
+                continue
+            anc = set()
+            frontier = [out_name]
+            while frontier:
+                n = frontier.pop()
+                n = self._extra_producer.get(n, n)
+                if n in anc:
+                    continue
+                anc.add(n)
+                frontier.extend(self.conf.layer(n).input_names())
+            cost_anc = [c for c in self.cost_names if c in anc]
+            if cost_anc:
+                derived.append(out_name)
+                absorbed.update(cost_anc)
+        if derived:
+            self.cost_names = [
+                n for n in self.cost_names if n not in absorbed
+            ] + derived
         self.input_names = list(conf.input_layer_names) or [
             lc.name for lc in conf.layers if lc.type == "data"
         ]

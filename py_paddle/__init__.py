@@ -1,0 +1,13 @@
+"""`py_paddle` import-namespace shim.
+
+Reference: paddle/py_paddle/__init__.py — exports the SWIG module
+`swig_paddle` plus DataProviderConverter, so the reference's API-driven
+demo drivers (`from py_paddle import swig_paddle, DataProviderConverter`,
+v1_api_demo/quick_start/api_train.py:17) execute unmodified against
+paddle_tpu.
+"""
+
+from py_paddle import swig_paddle  # noqa: F401
+from py_paddle.dataprovider_converter import DataProviderConverter  # noqa: F401
+
+__all__ = ["swig_paddle", "DataProviderConverter"]

@@ -1,0 +1,271 @@
+"""paddle.v2 namespace shim tests — reference-style v2 programs run
+unmodified (VERDICT r2 item 2; reference python/paddle/v2/trainer.py:24,
+145-176, layer.py:263, parameters.py:43).
+
+Each test is written the way a reference v2 user script is written:
+`import paddle.v2 as paddle`, paddle.init, paddle.layer.*,
+paddle.trainer.SGD(...).train(...) with an event handler.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle.v2 as paddle
+from paddle.v2 import config_base
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    config_base.reset()
+    yield
+    config_base.reset()
+
+
+def _toy_classification_reader(n=160, dim=16, classes=4, seed=1):
+    rng = np.random.default_rng(0)
+    W = rng.standard_normal((dim, classes))
+
+    def reader():
+        r = np.random.default_rng(seed)
+        for _ in range(n):
+            x = r.standard_normal(dim).astype(np.float32)
+            yield x, int(np.argmax(x @ W))
+
+    return reader
+
+
+def test_v2_mlp_trains_with_events_and_metrics():
+    """The reference mnist-style program shape: data/fc/fc + softmax +
+    classification cost, Momentum, event handler reading cost and
+    batch metrics (trainer.py:145-176 loop semantics)."""
+    paddle.init(use_gpu=False, trainer_count=1)
+    images = paddle.layer.data(
+        name="pixel", type=paddle.data_type.dense_vector(16)
+    )
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(4)
+    )
+    hidden = paddle.layer.fc(
+        input=images, size=32, act=paddle.activation.Relu()
+    )
+    predict = paddle.layer.fc(
+        input=hidden, size=4, act=paddle.activation.Softmax()
+    )
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+    paddle.evaluator.classification_error(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(
+        momentum=0.9, learning_rate=0.05,
+        regularization=paddle.optimizer.L2Regularization(rate=1e-4),
+    )
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters, update_equation=optimizer
+    )
+
+    seen = []
+    costs = []
+    pass_errors = []
+
+    def event_handler(event):
+        seen.append(type(event).__name__)
+        if isinstance(event, paddle.event.EndIteration):
+            costs.append(event.cost)
+            assert isinstance(event.cost, float)
+            assert "classification_error" in event.metrics
+        if isinstance(event, paddle.event.EndPass):
+            pass_errors.append(event.metrics["classification_error"])
+
+    reader = _toy_classification_reader()
+    trainer.train(
+        reader=paddle.batch(paddle.reader.shuffle(reader, 256), 32),
+        num_passes=6,
+        event_handler=event_handler,
+    )
+    # event ordering: BeginPass before iterations, EndPass after
+    assert seen[0] == "BeginPass"
+    assert seen[1] == "BeginIteration"
+    assert seen[2] == "EndIteration"
+    assert seen[-1] == "EndPass"
+    assert pass_errors[-1] < pass_errors[0] - 0.2, pass_errors
+    assert np.mean(costs[-5:]) < np.mean(costs[:5])
+
+    # test() returns the reference TestResult (cost + metrics)
+    result = trainer.test(reader=paddle.batch(reader, 32))
+    assert result.cost == pytest.approx(np.mean(costs[-5:]), rel=1.0)
+    assert "classification_error" in result.metrics
+
+
+def test_v2_regression_uci_housing_style():
+    """The uci_housing demo shape (fc size=1 + mse_cost) with default
+    feeding order and inference via paddle.infer."""
+    paddle.init(use_gpu=False)
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(13))
+    y = paddle.layer.data(name="y", type=paddle.data_type.dense_vector(1))
+    y_predict = paddle.layer.fc(
+        input=x, size=1, act=paddle.activation.Linear()
+    )
+    cost = paddle.layer.mse_cost(input=y_predict, label=y)
+
+    parameters = paddle.parameters.create(cost)
+    optimizer = paddle.optimizer.Momentum(momentum=0.9, learning_rate=2e-2)
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=parameters, update_equation=optimizer
+    )
+
+    w_true = np.arange(13, dtype=np.float32) / 13.0
+
+    def reader():
+        r = np.random.default_rng(7)
+        for _ in range(200):
+            xv = r.standard_normal(13).astype(np.float32)
+            yield xv, np.array([xv @ w_true], np.float32)
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, 25),
+        num_passes=12,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration)
+        else None,
+    )
+    assert costs[-1] < 0.25 * costs[0], (costs[0], costs[-1])
+
+    probe = np.eye(13, dtype=np.float32)
+    out = paddle.infer(
+        output_layer=y_predict,
+        parameters=parameters,
+        input=[(row,) for row in probe],
+    )
+    np.testing.assert_allclose(
+        np.asarray(out).ravel(), w_true, atol=0.35
+    )
+
+
+def test_v2_parameters_tar_round_trip_and_infer_parity():
+    paddle.init()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    out = paddle.layer.fc(input=x, size=5, act=paddle.activation.Softmax())
+    lbl = paddle.layer.data(name="l", type=paddle.data_type.integer_value(5))
+    cost = paddle.layer.classification_cost(input=out, label=lbl)
+    parameters = paddle.parameters.create(cost)
+
+    # numpy dict surface (parameters.py:43)
+    names = parameters.names()
+    assert names and all(parameters.get(n) is not None for n in names)
+    w = parameters.get(names[0])
+    parameters.set(names[0], np.ones_like(w))
+
+    buf = io.BytesIO()
+    parameters.to_tar(buf)
+    buf.seek(0)
+    p2 = paddle.parameters.Parameters.from_tar(buf)
+    assert sorted(p2.names()) == sorted(names)
+
+    probe = [(np.linspace(-1, 1, 8).astype(np.float32),)]
+    y1 = paddle.infer(output_layer=out, parameters=parameters, input=probe)
+    y2 = paddle.infer(output_layer=out, parameters=p2, input=probe)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+def test_v2_sequence_model_trains():
+    """Sequence path: embedding + simple_lstm + pooling over an
+    integer_value_sequence slot (the imdb stacked-lstm program shape)."""
+    paddle.init()
+    words = paddle.layer.data(
+        name="words", type=paddle.data_type.integer_value_sequence(30)
+    )
+    label = paddle.layer.data(
+        name="label", type=paddle.data_type.integer_value(2)
+    )
+    emb = paddle.layer.embedding(input=words, size=16)
+    lstm = paddle.networks.simple_lstm(input=emb, size=16)
+    pooled = paddle.layer.pooling(
+        input=lstm, pooling_type=paddle.pooling.Max()
+    )
+    predict = paddle.layer.fc(
+        input=pooled, size=2, act=paddle.activation.Softmax()
+    )
+    cost = paddle.layer.classification_cost(input=predict, label=label)
+
+    parameters = paddle.parameters.create(cost)
+    trainer = paddle.trainer.SGD(
+        cost=cost,
+        parameters=parameters,
+        update_equation=paddle.optimizer.Adam(learning_rate=0.02),
+    )
+
+    def reader():
+        r = np.random.default_rng(3)
+        for _ in range(120):
+            n = int(r.integers(3, 9))
+            # class 1 sequences use high token ids, class 0 low ones
+            y = int(r.integers(0, 2))
+            lo, hi = (15, 30) if y else (0, 15)
+            yield list(map(int, r.integers(lo, hi, n))), y
+
+    costs = []
+    trainer.train(
+        reader=paddle.batch(reader, 30),
+        num_passes=8,
+        event_handler=lambda e: costs.append(e.cost)
+        if isinstance(e, paddle.event.EndIteration)
+        else None,
+    )
+    assert costs[-1] < 0.6 * costs[0], (costs[0], costs[-1])
+
+
+def test_v2_reader_combinators_and_batch():
+    paddle.init()
+    r = paddle.reader.shuffle(
+        paddle.reader.firstn(lambda: iter(range(100)), 50), 16
+    )
+    items = [b for b in paddle.batch(r, 8)()]
+    assert sum(len(b) for b in items) == 50
+    # trailing partial batch included (minibatch.py:22-41)
+    assert len(items[-1]) == 2
+
+    mapped = paddle.reader.map_readers(lambda a, b: a + b,
+                                       lambda: iter([1, 2]),
+                                       lambda: iter([10, 20]))
+    assert list(mapped()) == [11, 22]
+
+    x = paddle.reader.xmap_readers(lambda s: s * 2, lambda: iter([1, 2, 3]))
+    assert list(x()) == [2, 4, 6]
+
+
+def test_v2_dataset_namespace():
+    import importlib
+
+    m = importlib.import_module("paddle.v2.dataset.mnist")
+    assert m is paddle.dataset.mnist
+    assert callable(paddle.dataset.mnist.train)
+    assert callable(paddle.dataset.uci_housing.train)
+
+
+def test_v2_op_math():
+    paddle.init()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    y = paddle.v2.op.square(x) if hasattr(paddle, "v2") else None
+    from paddle.v2 import op
+
+    sq = op.square(x)
+    topo = paddle.topology.Topology(sq)
+    net_conf = topo.proto()
+    from paddle_tpu.network import Network
+
+    net = Network(net_conf)
+    import jax
+
+    params = net.init_params(jax.random.PRNGKey(0))
+    from paddle_tpu.core.arg import Arg
+    import jax.numpy as jnp
+
+    outs, _ = net.forward(
+        params, {"x": Arg(value=jnp.asarray([[1.0, -2.0, 3.0, -4.0]]))}
+    )
+    np.testing.assert_allclose(
+        np.asarray(outs[sq.name].value), [[1.0, 4.0, 9.0, 16.0]]
+    )
