@@ -18,9 +18,11 @@ __all__ = []
 def _declare(type_, input=None, label=None, name=None, **kw):
     config_base.global_graph()
     if isinstance(input, (list, tuple)):
+        # one conf per input; names must stay distinct or their metrics
+        # would shadow each other in the trainer's results dict
+        base = name or type_
         return [
-            _declare(type_, x, label, f"{name}_{i}" if name and i else name,
-                     **kw)
+            _declare(type_, x, label, f"{base}_{i}" if i else base, **kw)
             for i, x in enumerate(input)
         ]
     conf = {"type": type_}

@@ -5,18 +5,11 @@ partial batch too).
 """
 
 
-def batch(reader, batch_size):
-    def batch_reader():
-        b = []
-        for instance in reader():
-            b.append(instance)
-            if len(b) == batch_size:
-                yield b
-                b = []
-        if b:
-            yield b
+from paddle_tpu.data.reader import batched
 
-    return batch_reader
+
+def batch(reader, batch_size):
+    return batched(reader, batch_size, drop_last=False)
 
 
 __all__ = ["batch"]

@@ -165,13 +165,32 @@ def cmd_train(args):
 
 
 def _is_v1_config(path: str) -> bool:
-    """A config without any mention of get_config (defined, imported,
-    or aliased) is an unmodified v1 file for compat parse_config.
-    `get_config_arg` (the v1 --config_args accessor) must NOT count."""
-    import re
+    """A config is v2-native iff its module BINDS the name `get_config`
+    at top level (def / assignment / import); everything else is an
+    unmodified v1 file for compat parse_config. Decided from the AST —
+    a substring match would misroute a v1 config that merely mentions
+    get_config in a comment or defines get_configuration."""
+    import ast
 
     with open(path) as f:
-        return re.search(r"get_config(?!_arg)", f.read()) is None
+        try:
+            tree = ast.parse(f.read(), path)
+        except SyntaxError:
+            return True  # py2-era source: certainly a v1 config
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name == "get_config":
+                return False
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "get_config":
+                    return False
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if (alias.asname or alias.name) == "get_config":
+                    return False
+    return True
 
 
 def _v1_setup(config_path, config_args, which="train"):

@@ -390,6 +390,8 @@ class GradientMachine:
         self._param_names = sorted(self.net.param_confs)
         self._fwd_cache: dict = {}
         self._last = None  # (outs, feed) of the latest forward
+        self._rng_key = _rng.root_key(seed or _flags.get_flag("seed"))
+        self._rng_step = 0
         # implied evaluators (classification_error per classification
         # cost), what the reference's makeEvaluator materializes
         self._eval_confs = []
@@ -471,20 +473,32 @@ class GradientMachine:
         if key not in self._fwd_cache:
             keep = self._keep
 
-            def fwd(params, state, feed):
-                outs, _ = self.net.forward(
-                    params, feed, state=state, train=False
+            def fwd(params, state, feed, rng):
+                outs, new_state = self.net.forward(
+                    params, feed, state=state, train=train, rng=rng
                 )
-                return {n: outs[n] for n in keep if n in outs}
+                return (
+                    {n: outs[n] for n in keep if n in outs},
+                    new_state,
+                )
 
             self._fwd_cache[key] = jax.jit(fwd)
         return self._fwd_cache[key]
 
+    def _next_rng(self):
+        self._rng_step += 1
+        return _rng.split_for_step(self._rng_key, self._rng_step)
+
     def forward(self, inArgs: Arguments, outArgs: Arguments, passType=None):
+        train = passType == PASS_TRAIN
         feed = inArgs._feed(self.net.input_names)
-        outs = self._fwd(passType == PASS_TRAIN)(
-            self.params, self.state, feed
+        outs, new_state = self._fwd(train)(
+            self.params, self.state, feed, self._next_rng()
         )
+        if train:
+            # train-mode forward advances batch-norm running stats,
+            # exactly like the reference GradientMachine
+            self.state = new_state
         self._last = (outs, feed)
         outArgs.resize(len(self.net.output_names))
         for i, n in enumerate(self.net.output_names):
@@ -499,7 +513,9 @@ class GradientMachine:
         """Reference api: returns [{'id': ids, 'value': values}] per
         output layer (py_paddle util swig_paddle.py forwardTest)."""
         feed = inArgs._feed(self.net.input_names)
-        outs = self._fwd(False)(self.params, self.state, feed)
+        outs, _ = self._fwd(False)(
+            self.params, self.state, feed, self._next_rng()
+        )
         self._last = (outs, feed)
         res = []
         for n in self.net.output_names:
@@ -520,19 +536,20 @@ class GradientMachine:
         if "grad" not in self._fwd_cache:
             keep = self._keep
 
-            def fb(params, state, feed):
-                (loss, (outs, _)), grads = jax.value_and_grad(
+            def fb(params, state, feed, rng):
+                (loss, (outs, new_state)), grads = jax.value_and_grad(
                     self.net.loss_fn, has_aux=True
-                )(params, feed, state=state, train=True)
+                )(params, feed, state=state, train=True, rng=rng)
                 return loss, grads, {
                     n: outs[n] for n in keep if n in outs
-                }
+                }, new_state
 
             self._fwd_cache["grad"] = jax.jit(fb)
-        loss, grads, outs = self._fwd_cache["grad"](
-            self.params, self.state, feed
+        loss, grads, outs, new_state = self._fwd_cache["grad"](
+            self.params, self.state, feed, self._next_rng()
         )
         self._grads = grads
+        self.state = new_state
         self._last = (outs, feed)
         outArgs.resize(len(self.net.output_names))
         for i, n in enumerate(self.net.output_names):

@@ -79,6 +79,17 @@ class MasterClient:
         return status, resp[8:]
 
     def _call(self, op: int, body: bytes = b"") -> tuple:
+        """AT-LEAST-ONCE delivery: a request retried after a connection
+        error may have already been processed by the server. The
+        protocol is designed so every duplicate is safe-by-semantics:
+        duplicate TASK_DONE/TASK_FAILED return -1 (same as an expired
+        lease — the caller path already treats that as lease-lost, and
+        lease-timeout requeue makes task execution at-least-once anyway,
+        exactly like the reference's Go master, go/master/service.go:313);
+        duplicate GET_TASK just leases another task; a duplicate
+        ADD_TASK can enqueue a chunk twice, which costs one redundant
+        task but never corrupts pass accounting (the duplicate is its
+        own task with its own done entry)."""
         deadline = time.monotonic() + self._retry
         delay = 0.05
         while True:

@@ -81,19 +81,29 @@ class BatchNormLayer(Layer):
             # backward already keeps
             mean = jnp.sum(x * m.astype(x.dtype), axis=red,
                            dtype=f32) / n
-            msq = jnp.sum(jnp.square(x) * m.astype(x.dtype), axis=red,
-                          dtype=f32) / n
-            var = jnp.maximum(msq - jnp.square(mean), 0.0)
+            if x.dtype == f32:
+                # full precision input: centered second moment — no
+                # E[x^2]-E[x]^2 cancellation, and the saved residual is
+                # x itself (no extra memory vs the one-pass form)
+                d = (x - mean.astype(x.dtype)) * m.astype(x.dtype)
+                var = jnp.sum(jnp.square(d), axis=red, dtype=f32) / n
+            else:
+                msq = jnp.sum(jnp.square(x) * m.astype(x.dtype),
+                              axis=red, dtype=f32) / n
+                var = jnp.maximum(msq - jnp.square(mean), 0.0)
             ctx.updated_state[self.name] = {
                 "mean": st["mean"] * frac + mean * (1 - frac),
                 "var": st["var"] * frac + var * (1 - frac),
             }
         else:
-            # see the masked branch: square in x's dtype + f32
-            # accumulation keeps autodiff from saving an f32 upcast
+            # see the masked branch: E[x^2]-E[x]^2 (one bf16 pass) only
+            # under AMP; full-precision inputs get the centered form
             mean = jnp.mean(x, axis=red, dtype=f32)
-            msq = jnp.mean(jnp.square(x), axis=red, dtype=f32)
-            var = jnp.maximum(msq - jnp.square(mean), 0.0)
+            if x.dtype == f32:
+                var = jnp.mean(jnp.square(x - mean), axis=red, dtype=f32)
+            else:
+                msq = jnp.mean(jnp.square(x), axis=red, dtype=f32)
+                var = jnp.maximum(msq - jnp.square(mean), 0.0)
             ctx.updated_state[self.name] = {
                 "mean": st["mean"] * frac + mean * (1 - frac),
                 "var": st["var"] * frac + var * (1 - frac),

@@ -36,6 +36,8 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
+#include <set>
 #include <string>
 #include <thread>
 #include <vector>
@@ -65,6 +67,13 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::thread snapshot_thread;
+  // live connection sockets: stop() shuts them down so their threads'
+  // blocking recv returns, then waits for active_conns to drain before
+  // the Server is freed (no use-after-free on s->m / snapshot_path)
+  std::mutex conns_mu;
+  std::set<int> conn_fds;
+  std::atomic<int> active_conns{0};
+  bool listen_closed = false;  // guarded by conns_mu
 };
 
 bool read_full(int fd, void* buf, size_t n) {
@@ -208,16 +217,40 @@ void handle_conn(Server* s, int fd) {
       case 12:
         respond(fd, 0, "");
         s->stop.store(true);
-        // unblock the accept loop
-        shutdown(s->listen_fd, SHUT_RDWR);
-        close(fd);
+        // unblock the accept loop; conn_main closes this socket.
+        // listen_fd shutdown is guarded so it cannot race stop()'s
+        // close() onto a recycled descriptor
+        {
+          std::lock_guard<std::mutex> g(s->conns_mu);
+          if (!s->listen_closed) shutdown(s->listen_fd, SHUT_RDWR);
+        }
         return;
       default:
         ok = respond(fd, -100, "");
     }
     if (!ok) break;
   }
-  close(fd);
+}
+
+// registers the connection, runs handle_conn, deregisters — the unit
+// the detached per-connection threads execute. The socket is closed
+// here under the registry lock so stop() can never shutdown() a
+// recycled descriptor.
+void conn_main(Server* s, int fd) {
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    s->conn_fds.insert(fd);
+    // stop() may have swept conn_fds between our accept and this
+    // registration — shut the socket down ourselves so recv returns
+    if (s->stop.load()) shutdown(fd, SHUT_RDWR);
+  }
+  handle_conn(s, fd);
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    s->conn_fds.erase(fd);
+    close(fd);
+  }
+  s->active_conns.fetch_sub(1);
 }
 
 }  // namespace
@@ -261,8 +294,10 @@ Server* pt_master_server_start(Master* m, int port, const char* snapshot_path,
         if (s->stop.load()) break;
         continue;
       }
-      // detached: a handful of trainer conns; they exit on client close
-      std::thread(handle_conn, s, cfd).detach();
+      // detached but registered: stop() shuts the sockets down and
+      // waits for the count to drain before freeing the Server
+      s->active_conns.fetch_add(1);
+      std::thread(conn_main, s, cfd).detach();
     }
   });
   if (!s->snapshot_path.empty() && snapshot_every_s > 0) {
@@ -286,18 +321,32 @@ int pt_master_server_stopped(Server* s) {
   return s && s->stop.load() ? 1 : 0;
 }
 
-// Stop accepting, join service threads, snapshot one last time if
-// configured. Detached connection threads may still run until their
-// client disconnects — destroy the Master only on process exit.
+// Stop accepting, join service threads, force open connections closed
+// and wait for their threads to drain, snapshot one last time if
+// configured. If a connection thread is wedged past the drain timeout
+// the Server is intentionally leaked instead of freed under it.
 void pt_master_server_stop(Server* s) {
   if (!s) return;
   s->stop.store(true);
-  shutdown(s->listen_fd, SHUT_RDWR);
-  close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    shutdown(s->listen_fd, SHUT_RDWR);
+    close(s->listen_fd);
+    s->listen_closed = true;
+  }
   if (s->accept_thread.joinable()) s->accept_thread.join();
   if (s->snapshot_thread.joinable()) s->snapshot_thread.join();
+  {
+    // unblock every connection thread's recv
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (int fd : s->conn_fds) shutdown(fd, SHUT_RDWR);
+  }
+  for (int waited_ms = 0;
+       s->active_conns.load() > 0 && waited_ms < 5000; waited_ms += 10)
+    std::this_thread::sleep_for(std::chrono::milliseconds(10));
   if (!s->snapshot_path.empty())
     pt_master_snapshot(s->m, s->snapshot_path.c_str());
+  if (s->active_conns.load() > 0) return;  // leak rather than UAF
   delete s;
 }
 

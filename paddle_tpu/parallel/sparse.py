@@ -104,10 +104,21 @@ def sparse_apply(update_fn, param, ids, grads, state=(), num_slots=None):
     )
     valid = uids >= 0
     safe = jnp.where(valid, uids, 0)
+    # Capacity guard: with num_slots below the batch's true unique
+    # count, jnp.unique truncates and `inv` aliases the dropped ids
+    # onto surviving slots — their gradients would land on WRONG rows.
+    # An occurrence only contributes where its slot really holds its
+    # id; overflowed ids are skipped this step (matching the
+    # prefetch-capacity semantics of SparsePrefetchRowCpuMatrix rather
+    # than corrupting neighbors).
+    inv_flat = inv.reshape(-1)
+    hit = (uids[inv_flat] == ids).astype(grads.dtype)
+    gflat = grads.reshape((n,) + grads.shape[1:])
+    gflat = gflat * hit.reshape((n,) + (1,) * (gflat.ndim - 1))
     gsum = (
         jnp.zeros((k,) + grads.shape[1:], grads.dtype)
-        .at[inv.reshape(-1)]
-        .add(grads.reshape((n,) + grads.shape[1:]))
+        .at[inv_flat]
+        .add(gflat)
     )
     prows = param[safe]
     srows = tuple(s[safe] for s in state)
