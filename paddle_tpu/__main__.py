@@ -178,19 +178,27 @@ def _is_v1_config(path: str) -> bool:
         except SyntaxError:
             return True  # py2-era source: certainly a v1 config
 
-    for node in tree.body:
+    # bindings anywhere at module scope count, including under try/if
+    # (guarded imports); class/function BODIES don't bind module names,
+    # so those subtrees are not descended into
+    def binds(node) -> bool:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            if node.name == "get_config":
-                return False
-        elif isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name) and t.id == "get_config":
-                    return False
-        elif isinstance(node, (ast.Import, ast.ImportFrom)):
-            for alias in node.names:
-                if (alias.asname or alias.name) == "get_config":
-                    return False
-    return True
+            return node.name == "get_config"
+        if isinstance(node, ast.ClassDef):
+            return False
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "get_config"
+            for t in node.targets
+        ):
+            return True
+        if isinstance(node, (ast.Import, ast.ImportFrom)) and any(
+            (alias.asname or alias.name) == "get_config"
+            for alias in node.names
+        ):
+            return True
+        return any(binds(c) for c in ast.iter_child_nodes(node))
+
+    return not any(binds(node) for node in tree.body)
 
 
 def _v1_setup(config_path, config_args, which="train"):

@@ -33,28 +33,28 @@ from paddle_tpu.ops import sequence_ops as sops
 
 
 def _use_fused(bsz=None, t_max=None, h=None, mult=4) -> bool:
-    """Fused Pallas cell policy: flag override, else auto — real TPU
-    AND a shape where the backward kernel engages (bb >= 32 plan).
-    Measured on v5e: when only the forward kernel fits (h=512+), the
-    fused-fwd + scan-recompute hybrid ties or loses to the pure scan
-    for training, so auto only engages where the full fused train path
-    wins. Force with flags.set_flag('use_pallas_rnn', True/False)."""
+    """Fused Pallas cell policy: explicit flag only.
+
+    Round-3 interleaved A/B measurement (bench.py
+    bench_lstm_fused_vs_scan: both arms compiled+warmed, alternating
+    timing windows, min per arm — immune to the tunnel-preemption bias
+    that produced round 2's contradictory numbers) shows XLA's
+    lax.scan lowering BEATS the fused Pallas kernels on v5e at every
+    tested shape, training AND inference:
+      train  scan/fused: bs128 h256 0.85x, bs128 h512 1.04x (noise),
+             bs128 h1280 0.81x, bs256 h256 0.59x, bs256 h512 0.64x
+      fwd    bs128 h256 0.92x, bs128 h512 0.87x, bs256 h512 0.52x
+    So auto NEVER engages the kernels; they remain available for
+    explicit opt-in (flags.set_flag('use_pallas_rnn', True)) and are
+    correctness-tested in test_pallas_kernels.py. The capability match
+    for cuda/src/hl_cuda_lstm.cu is the kernels' existence; the perf
+    match on TPU is the scan+XLA path."""
     from paddle_tpu.core.flags import get_flag
-    from paddle_tpu.ops import pallas_rnn
 
     v = get_flag("use_pallas_rnn")
     if v is not None:
         return bool(v)
-    if not pallas_rnn.use_fused_default():
-        return False
-    if bsz is None:
-        return True
-    plan = (
-        pallas_rnn._lstm_bwd_plan(bsz, t_max, h)
-        if mult == 4
-        else pallas_rnn._gru_bwd_plan(bsz, t_max, h)
-    )
-    return plan is not None and plan[0] >= 32
+    return False
 
 
 def _interpret_mode() -> bool:

@@ -19,6 +19,7 @@ from paddle_tpu.parallel.sharding import (  # noqa: F401
     constrain,
 )
 from paddle_tpu.parallel.sparse import (  # noqa: F401
+    SparseUpdater,
     apply_rows,
     sparse_apply,
     embedding_lookup,

@@ -349,3 +349,91 @@ class TestSparseApply:
             _jax.block_until_ready(param)
             times[V] = time.perf_counter() - t0
         assert times[1 << 22] < times[1 << 18] * 4.0, times
+
+
+class TestSparseUpdaterKernel:
+    """SparseUpdater — the in-place Mosaic row-update kernel (interpret
+    mode on the CPU mesh) vs the sparse_apply oracle. Production
+    rationale + TPU measurements in PERF.md (the single-program XLA
+    formulation pays full-table relayout copies)."""
+
+    def _upd(self, p, g, m):
+        m2 = 0.9 * m + g
+        return p - 0.01 * m2, m2
+
+    def test_matches_sparse_apply(self):
+        from paddle_tpu.parallel.sparse import SparseUpdater, sparse_apply
+
+        V, D, N = 200, 8, 48
+        rng = np.random.default_rng(0)
+        p0 = rng.standard_normal((V, D)).astype(np.float32)
+        m0 = rng.standard_normal((V, D)).astype(np.float32)
+        ids = jnp.asarray(rng.integers(0, V, N), jnp.int32)
+        grads = jnp.asarray(rng.standard_normal((N, D)), jnp.float32)
+
+        ref_p, (ref_m,) = sparse_apply(
+            self._upd, jnp.asarray(p0), ids, grads,
+            state=(jnp.asarray(m0),),
+        )
+        u = SparseUpdater(self._upd)
+        param, mom = u.place(p0), u.place(m0)
+        param, (mom,) = u(param, ids, grads, (mom,))
+        np.testing.assert_allclose(
+            u.unplace(param), np.asarray(ref_p), rtol=1e-5, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            u.unplace(mom), np.asarray(ref_m), rtol=1e-5, atol=1e-6
+        )
+
+    def test_multiple_steps_and_no_state(self):
+        from paddle_tpu.parallel.sparse import SparseUpdater, sparse_apply
+
+        V, D, N = 64, 4, 16
+
+        def upd(p, g):
+            return p - 0.5 * g
+
+        rng = np.random.default_rng(3)
+        p0 = rng.standard_normal((V, D)).astype(np.float32)
+        ref = jnp.asarray(p0)
+        u = SparseUpdater(upd)
+        param = u.place(p0)
+        for step in range(3):
+            ids = jnp.asarray(rng.integers(0, V, N), jnp.int32)
+            grads = jnp.asarray(
+                rng.standard_normal((N, D)), jnp.float32
+            )
+            ref, _ = sparse_apply(upd, ref, ids, grads)
+            param, _ = u(param, ids, grads)
+        np.testing.assert_allclose(
+            u.unplace(param), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+    def test_overflow_skips_not_corrupts(self):
+        """num_slots below the unique count: overflowed ids are skipped
+        this step; surviving rows update exactly, others unchanged."""
+        from paddle_tpu.parallel.sparse import SparseUpdater
+
+        V, D = 40, 4
+
+        def upd(p, g):
+            return p - g
+
+        # 6 unique ids, capacity 4: the 4 smallest survive (unique'd
+        # ascending), 2 overflow
+        ids = jnp.asarray([10, 20, 30, 35, 5, 15], jnp.int32)
+        grads = jnp.ones((6, D), jnp.float32)
+        p0 = np.zeros((V, D), np.float32)
+        u = SparseUpdater(upd, num_slots=4)
+        param = u.place(p0)
+        param, _ = u(param, ids, grads)
+        out = u.unplace(param)
+        updated = {i for i in (5, 10, 15, 20, 30, 35) if out[i].sum() != 0}
+        untouched_ok = all(
+            out[i].sum() == 0 for i in range(V)
+            if i not in (5, 10, 15, 20, 30, 35)
+        )
+        assert untouched_ok
+        assert updated == {5, 10, 15, 20}, updated
+        for i in (5, 10, 15, 20):
+            np.testing.assert_allclose(out[i], -np.ones(D), atol=1e-6)
