@@ -69,8 +69,13 @@ def cmd_dump_config(args):
 
 
 def cmd_train(args):
+    from paddle_tpu.launch import distributed_init_from_env
     from paddle_tpu.trainer import SGD
     from paddle_tpu.trainer import events
+
+    # under `paddle launch` every worker carries the rendezvous env —
+    # join it before any device use (cluster_train trainer_id wiring)
+    distributed_init_from_env()
 
     # --job=test needs only the config's TEST data source; everything
     # else drives the train source. The config is parsed exactly once.
@@ -351,6 +356,18 @@ def cmd_bench(args):
     return 0
 
 
+def _cmd_launch(args):
+    from paddle_tpu import launch as _launch
+
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        raise SystemExit("launch: give the worker command after --")
+    args.command = cmd
+    return _launch.main(args)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="paddle", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -407,6 +424,25 @@ def main(argv=None):
     sp = sub.add_parser("bench", help="run the benchmark harness")
     sp.add_argument("--script", default="bench.py")
     sp.set_defaults(fn=cmd_bench)
+
+    sp = sub.add_parser(
+        "launch",
+        help="start a multi-host job (the cluster_train/paddle.py "
+             "ssh launcher, TPU-shaped: one jax.distributed process "
+             "per host)",
+    )
+    sp.add_argument("--hosts", required=True,
+                    help="comma-separated host list; first runs the "
+                         "coordinator. localhost spawns locally")
+    sp.add_argument("--nproc-per-host", type=int, default=1)
+    sp.add_argument("--port", type=int, default=7164,
+                    help="coordinator port on the first host")
+    sp.add_argument("--ssh-opts", default="",
+                    help="extra ssh options, e.g. '-i key.pem'")
+    sp.add_argument("command", nargs=argparse.REMAINDER,
+                    help="the per-process command (after --), e.g. "
+                         "python -m paddle_tpu train --config cfg.py")
+    sp.set_defaults(fn=_cmd_launch)
 
     sp = sub.add_parser("version", help="print versions")
     sp.set_defaults(fn=cmd_version)

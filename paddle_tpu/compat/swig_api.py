@@ -381,20 +381,25 @@ class Evaluator:
 class GradientMachine:
     """api/PaddleAPI.h:402 GradientMachine over a jitted Network.
 
-    seed=None defers to the global 'seed' flag; an explicit seed
-    (including 0) governs BOTH parameter init and the dropout rng."""
+    seed=None defers to the global 'seed' flag (whose 0 means a fresh
+    OS-entropy seed); an explicit seed — including 0 — is honored
+    exactly and governs BOTH parameter init and the dropout rng."""
 
     def __init__(self, conf, seed: int | None = None):
         self.conf = conf
-        resolved = _flags.get_flag("seed") if seed is None else seed
+        if seed is not None:
+            root = jax.random.PRNGKey(seed)
+        else:
+            # flag semantics: 0 = nondeterministic (core/flags.py)
+            root = _rng.root_key(_flags.get_flag("seed"))
+        init_key, self._rng_key = jax.random.split(root)
         self.net = Network(conf)
-        self.params = self.net.init_params(jax.random.PRNGKey(resolved))
+        self.params = self.net.init_params(init_key)
         self.state = self.net.init_state()
         self._grads: dict = {}
         self._param_names = sorted(self.net.param_confs)
         self._fwd_cache: dict = {}
         self._last = None  # (outs, feed) of the latest forward
-        self._rng_key = _rng.root_key(resolved)
         self._rng_step = 0
         # implied evaluators (classification_error per classification
         # cost), what the reference's makeEvaluator materializes

@@ -48,7 +48,12 @@ def _use_fused(bsz=None, t_max=None, h=None, mult=4) -> bool:
     explicit opt-in (flags.set_flag('use_pallas_rnn', True)) and are
     correctness-tested in test_pallas_kernels.py. The capability match
     for cuda/src/hl_cuda_lstm.cu is the kernels' existence; the perf
-    match on TPU is the scan+XLA path."""
+    match on TPU is the scan+XLA path.
+
+    The shape parameters are intentionally retained (unused) so call
+    sites keep passing them — if a future XLA/Mosaic shift flips the
+    A/B (the bench row watches it), the shape-dependent policy slots
+    back in without touching callers."""
     from paddle_tpu.core.flags import get_flag
 
     v = get_flag("use_pallas_rnn")

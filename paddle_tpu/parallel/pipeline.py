@@ -73,6 +73,7 @@ def pipeline_apply(
     stage_fn: Callable,
     stacked_params,
     xs: jax.Array,
+    batch_axis: str | None = None,
 ):
     """Run the pipeline.
 
@@ -80,10 +81,17 @@ def pipeline_apply(
     size mesh.shape[axis_name], sharded over `axis_name` (see
     `shard_stacked_params`). xs: [M, micro_batch, ...] microbatches.
     Returns [M, micro_batch, ...] outputs. Differentiable end-to-end.
+
+    batch_axis: name of a mesh data axis to shard the micro_batch dim
+    over — pp×dp in one program (each data shard streams its slice of
+    every microbatch through the same pipe; stage params are replicated
+    across `batch_axis`, so their gradient allreduce over data is
+    inserted by shard_map's transpose automatically).
     """
+    xspec = P(None, batch_axis) if batch_axis else P()
     in_specs = (
         jax.tree_util.tree_map(lambda _: P(axis_name), stacked_params),
-        P(),
+        xspec,
     )
 
     def local(params, xs):
@@ -95,7 +103,7 @@ def pipeline_apply(
         local,
         mesh=mesh,
         in_specs=in_specs,
-        out_specs=P(),
+        out_specs=xspec,
         check_vma=False,
     )(stacked_params, xs)
 

@@ -188,14 +188,17 @@ class SparseUpdater:
     tiling rule for single-row blocks). `unplace()` returns a plain
     [V, D] numpy view for checkpointing.
 
-    Overflow: ids are unique'd to sorted order; fill slots map to a
-    dedicated SCRATCH row appended by `place()` (index V), so invalid
-    slots write only scratch — never a real row. (Masking the write
-    instead would race: the pipeline prefetches each slot's block
-    before earlier slots' write-backs, so an "unchanged" write of a
-    real row could clobber a real update.) `num_slots` overflow slots
-    land on scratch too: skipped, never corrupting neighbors
-    (sparse_apply's contract).
+    Overflow: when the batch touches FEWER than num_slots unique rows,
+    the unused fill slots map to a dedicated SCRATCH row appended by
+    `place()` (index V), so they write only scratch — never a real
+    row. (Masking the write instead would race: the pipeline
+    prefetches each slot's block before earlier slots' write-backs, so
+    an "unchanged" write of a real row could clobber a real update.)
+    When the batch touches MORE than num_slots unique rows,
+    jnp.unique truncation keeps the num_slots SMALLEST ids; the
+    dropped ids' gradients are zeroed by the hit-mask in
+    _unique_segment_grads before the kernel ever runs — skipped this
+    step, never corrupting neighbors (sparse_apply's contract).
 
     Usage:
         upd = SparseUpdater(momentum_update)

@@ -1,0 +1,75 @@
+"""Multi-host launcher (VERDICT r2 item 10; reference
+paddle/scripts/cluster_train/paddle.py:24-157): `python -m paddle_tpu
+launch --hosts ...` starts one rendezvous-wired process per slot,
+merges their output, and fails fast."""
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_OK = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.launch import distributed_init_from_env
+assert distributed_init_from_env()
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from paddle_tpu.core.mesh import make_mesh, DATA_AXIS
+mesh = make_mesh({DATA_AXIS: jax.device_count()})
+local = jnp.ones((jax.local_device_count(),)) * (jax.process_index() + 1)
+arr = jax.make_array_from_single_device_arrays(
+    (jax.device_count(),), NamedSharding(mesh, P(DATA_AXIS)),
+    [jax.device_put(local[i:i+1], d)
+     for i, d in enumerate(jax.local_devices())],
+)
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+print("RANK", jax.process_index(), "SUM", float(total), flush=True)
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu", "launch", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+    )
+
+
+def test_local_two_process_launch(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_OK)
+    r = _launch([
+        "--hosts", "localhost", "--nproc-per-host", "2",
+        "--port", str(_free_port()),
+        "--", sys.executable, str(script),
+    ])
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    # both ranks computed the same cross-process reduction:
+    # 2 procs x 2 local devices: sum = 1+1+2+2 = 6
+    assert "[rank0@localhost] RANK 0 SUM 6.0" in r.stdout
+    assert "[rank1@localhost] RANK 1 SUM 6.0" in r.stdout
+
+
+def test_launch_fail_fast(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os, sys\n"
+        "sys.exit(3 if os.environ['PADDLE_PROCESS_ID'] == '1' else 0)\n"
+    )
+    r = _launch([
+        "--hosts", "localhost", "--nproc-per-host", "2",
+        "--port", str(_free_port()),
+        "--", sys.executable, str(bad),
+    ], timeout=120)
+    assert r.returncode == 3, (r.returncode, r.stdout[-2000:])
