@@ -88,3 +88,21 @@ def test_mdlstmemory_alias():
     import paddle_tpu.layers  # noqa: F401
 
     assert LAYERS.get("mdlstmemory") is LAYERS.get("mdlstm")
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference tree not mounted")
+def test_every_reference_evaluator_name_registered():
+    """Same sweep for REGISTER_EVALUATOR (Evaluator.cpp:172-1346 +
+    CTCErrorEvaluator/ChunkEvaluator/DetectionMAPEvaluator)."""
+    pat = re.compile(r"REGISTER_EVALUATOR\((\w+)")
+    names = set()
+    for f in REF.rglob("*.cpp"):
+        names.update(pat.findall(f.read_text(errors="ignore")))
+    names.discard("__type_name")
+    assert len(names) >= 14, names
+
+    import paddle_tpu.evaluators  # noqa: F401
+    from paddle_tpu.core.registry import EVALUATORS
+
+    missing = sorted(n for n in names if n not in EVALUATORS)
+    assert not missing, f"evaluator names missing: {missing}"
