@@ -15,18 +15,33 @@ from . import config_base
 __all__ = []
 
 
+def _uniquify(base: str) -> str:
+    """First free name among base, base_1, base_2, ... — the reference
+    config parser auto-suffixes repeated evaluator names so two
+    same-type declarations don't shadow each other in the trainer
+    metrics dict."""
+    taken = {e.get("name") for e in config_base.EVALUATORS}
+    ev_name, i = base, 0
+    while ev_name in taken:
+        i += 1
+        ev_name = f"{base}_{i}"
+    return ev_name
+
+
 def _declare(type_, input=None, label=None, name=None, **kw):
     config_base.global_graph()
     if isinstance(input, (list, tuple)):
         # one conf per input; names must stay distinct or their metrics
-        # would shadow each other in the trainer's results dict
-        base = name or type_
+        # would shadow each other in the trainer's results dict — and
+        # the derived base must itself be uniquified when defaulted, or
+        # a second list declaration of the same type collides
+        base = name if name is not None else _uniquify(type_)
         return [
             _declare(type_, x, label, f"{base}_{i}" if i else base, **kw)
             for i, x in enumerate(input)
         ]
     conf = {"type": type_}
-    conf["name"] = name or type_
+    conf["name"] = name if name is not None else _uniquify(type_)
     if input is not None:
         conf["input"] = getattr(input, "name", input)
     if label is not None:

@@ -42,15 +42,6 @@ class Topology:
 
         keep = set()
         frontier = [resolve(n) for n in self.output_names + extra]
-        # evaluator inputs keep their layers alive too (reference:
-        # __get_used_layers__ walks evaluator inputs); inference
-        # topologies skip them so test-only label slots don't become
-        # required feeds
-        if with_evaluators:
-            for ev in config_base.EVALUATORS:
-                for k in ("input", "label", "query_id"):
-                    if k in ev:
-                        frontier.append(resolve(ev[k]))
         while frontier:
             n = frontier.pop()
             if n in keep:
@@ -86,15 +77,23 @@ class Topology:
             lc.name for lc in conf.layers if lc.type == "data"
         ]
         self.conf = conf
-        self.evaluator_confs = [
-            ev
-            for ev in config_base.EVALUATORS
-            if all(
-                resolve(ev[k]) in keep
-                for k in ("input", "label", "query_id")
-                if k in ev
-            )
-        ]
+        # Reference semantics (layer.py __get_used_evaluators__): prune
+        # from outputs only, then keep evaluators whose inputs are
+        # already inside the closure — a declared-but-unrelated
+        # evaluator must not widen the topology or add required feeds.
+        self.evaluator_confs = (
+            [
+                ev
+                for ev in config_base.EVALUATORS
+                if all(
+                    resolve(ev[k]) in keep
+                    for k in ("input", "label", "query_id")
+                    if k in ev
+                )
+            ]
+            if with_evaluators
+            else []
+        )
 
     def proto(self) -> ModelConf:
         """The pruned ModelConf (the analogue of topology.proto())."""

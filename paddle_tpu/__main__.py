@@ -191,10 +191,34 @@ def _is_v1_config(path: str) -> bool:
             return node.name == "get_config"
         if isinstance(node, ast.ClassDef):
             return False
+        def target_binds(t) -> bool:
+            if isinstance(t, ast.Name):
+                return t.id == "get_config"
+            if isinstance(t, (ast.Tuple, ast.List)):
+                return any(target_binds(e) for e in t.elts)
+            if isinstance(t, ast.Starred):
+                return target_binds(t.value)
+            return False
+
         if isinstance(node, ast.Assign) and any(
-            isinstance(t, ast.Name) and t.id == "get_config"
-            for t in node.targets
+            target_binds(t) for t in node.targets
         ):
+            return True
+        if isinstance(
+            node, (ast.AnnAssign, ast.AugAssign)
+        ) and target_binds(node.target):
+            return True
+        if isinstance(node, (ast.With, ast.AsyncWith)) and any(
+            item.optional_vars is not None
+            and target_binds(item.optional_vars)
+            for item in node.items
+        ):
+            return True
+        if isinstance(node, (ast.For, ast.AsyncFor)) and target_binds(
+            node.target
+        ):
+            return True
+        if isinstance(node, ast.NamedExpr) and target_binds(node.target):
             return True
         if isinstance(node, (ast.Import, ast.ImportFrom)) and any(
             (alias.asname or alias.name) == "get_config"

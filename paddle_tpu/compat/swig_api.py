@@ -616,10 +616,12 @@ class ParameterUpdater:
     def finishBatch(self, cost: float = 0.0):
         gm = self._gm
         if self._marked and gm._grads:
+            # unmarked parameters are simply absent from the grads dict:
+            # the optimizer leaves them (and their momentum/decay/LR
+            # state) untouched, matching the reference local updater
+            # for drivers that skip update() on frozen params
             grads = {
-                k: (v if k in self._marked
-                    else jax.numpy.zeros_like(v))
-                for k, v in gm._grads.items()
+                k: v for k, v in gm._grads.items() if k in self._marked
             }
             gm.params, self._opt_state = self._apply_fn(
                 grads, gm.params, self._opt_state, self.global_step

@@ -129,15 +129,27 @@ def launch(
 
     rc = 0
     try:
-        for p in procs:
-            code = p.wait()
-            if code and not rc:
-                rc = code
-                # fail fast: a dead member blocks the collective for
-                # everyone else — bring the job down
-                for q in procs:
-                    if q.poll() is None:
-                        q.kill()
+        # Poll ALL ranks, not procs[0] first: a crash on a later rank
+        # must be observed even while earlier ranks block forever in a
+        # collective waiting for it.
+        import time as _time
+
+        live = list(procs)
+        while live:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                if code and not rc:
+                    rc = code
+                    # fail fast: a dead member blocks the collective
+                    # for everyone else — bring the job down
+                    for q in procs:
+                        if q.poll() is None:
+                            q.kill()
+            if live:
+                _time.sleep(0.05)
     finally:
         for q in procs:
             if q.poll() is None:

@@ -73,3 +73,28 @@ def test_launch_fail_fast(tmp_path):
         "--", sys.executable, str(bad),
     ], timeout=120)
     assert r.returncode == 3, (r.returncode, r.stdout[-2000:])
+
+
+def test_launch_fail_fast_later_rank(tmp_path):
+    """ADVICE r3 (launch.py): a LATER rank dying while an earlier rank
+    blocks forever (stuck in a collective) must still trigger the kill
+    sweep — rank-order waiting would hang on rank 0 here."""
+    import time
+
+    from paddle_tpu.launch import launch
+
+    script = tmp_path / "hang_or_die.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['PADDLE_PROCESS_ID'] == '1':\n"
+        "    sys.exit(5)\n"
+        "time.sleep(600)\n"  # rank 0: 'blocked in a collective'
+    )
+    t0 = time.monotonic()
+    rc = launch(
+        "localhost", [sys.executable, str(script)], nproc_per_host=2,
+        coordinator_port=_free_port(),
+    )
+    assert rc == 5
+    # must come back far sooner than rank 0's 600 s sleep
+    assert time.monotonic() - t0 < 60

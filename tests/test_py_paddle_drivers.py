@@ -301,3 +301,64 @@ def test_mnist_api_train_runs_unmodified(tmp_path, monkeypatch):
         extra_globals={"xrange": xr},
     )
     config_base.reset()
+
+
+def test_updater_leaves_unmarked_params_untouched():
+    """ADVICE r3 (swig_api.py finishBatch): a parameter the driver never
+    passed to update() — a deliberately frozen param — must be left
+    untouched by the optimizer: no L2 decay, no momentum advance
+    (reference local updater applies per-parameter, only on update())."""
+    import jax
+
+    from paddle_tpu import dsl
+    from paddle_tpu.compat import swig_api as api
+    from paddle_tpu.core.config import OptimizationConf
+
+    with dsl.model() as m:
+        x = dsl.data("x", 4)
+        y = dsl.data("y", 3, is_ids=True)
+        h = dsl.fc(x, size=5, name="h", act="relu")
+        out = dsl.fc(h, size=3, name="out", act="softmax")
+        dsl.classification_cost(out, y)
+
+    gm = api.GradientMachine.createFromConfigProto(m.conf)
+    upd = api.ParameterUpdater.createLocalUpdater(
+        OptimizationConf(
+            learning_method="momentum", learning_rate=0.1, momentum=0.9,
+            l2_rate=0.05,  # decay would move even a zero-grad param
+        )
+    )
+    upd.init(gm)
+
+    rng = np.random.default_rng(0)
+    args = api.Arguments.createArguments(2)
+    args.setSlotValue(0, api.Matrix.createDenseFromNumpy(
+        rng.standard_normal((8, 4)).astype(np.float32)))
+    args.setSlotIds(1, api.IVector.createVectorFromNumpy(
+        rng.integers(0, 3, 8).astype(np.int32)))
+    out_args = api.Arguments.createArguments(0)
+
+    upd.startPass()
+    upd.startBatch(8)
+    gm.forwardBackward(args, out_args, api.PASS_TRAIN)
+    params = gm.getParameters()
+    marked = [p for p in params if p.getName().startswith("_h")]
+    frozen = [p for p in params if not p.getName().startswith("_h")]
+    assert marked and frozen
+    before = {p.getName(): np.asarray(gm.params[p.getName()]).copy()
+              for p in params}
+    for p in marked:
+        upd.update(p)
+    upd.finishBatch(0.0)
+
+    for p in marked:
+        n = p.getName()
+        assert not np.allclose(before[n], np.asarray(gm.params[n])), n
+    for p in frozen:
+        n = p.getName()
+        np.testing.assert_array_equal(
+            before[n], np.asarray(gm.params[n]), err_msg=n
+        )
+        # momentum state untouched too (still the zero init)
+        for leaf in jax.tree_util.tree_leaves(upd._opt_state[n]):
+            assert not np.any(np.asarray(leaf)), n

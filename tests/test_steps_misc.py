@@ -291,3 +291,27 @@ def test_get_output_named_layer():
     )
     outs, _ = net.forward(params, feed, outputs=["cell"])
     assert outs["cell"].value.shape == (4, 4)
+
+
+def test_is_v1_config_detects_nonplain_bindings(tmp_path):
+    """ADVICE r3 (__main__.py _is_v1_config): get_config bound via
+    tuple/starred/annotated assignment or `with ... as` is still a v2
+    config and must not be routed to the v1 compat parser."""
+    from paddle_tpu.__main__ import _is_v1_config
+
+    cases = {
+        "plain.py": "def get_config():\n    pass\n",
+        "tuple.py": "get_config, x = make(), 1\n",
+        "starred.py": "get_config, *rest = fns()\n",
+        "ann.py": "get_config: object = make()\n",
+        "withas.py": "with ctx() as get_config:\n    pass\n",
+        "forloop.py": "for get_config in (make(),):\n    break\n",
+        "walrus.py": "(get_config := make())\n",
+    }
+    for fname, src in cases.items():
+        p = tmp_path / fname
+        p.write_text(src)
+        assert not _is_v1_config(str(p)), fname
+    v1 = tmp_path / "v1.py"
+    v1.write_text("from paddle.trainer_config_helpers import *\n")
+    assert _is_v1_config(str(v1))

@@ -269,3 +269,69 @@ def test_v2_op_math():
     np.testing.assert_allclose(
         np.asarray(outs[sq.name].value), [[1.0, 4.0, 9.0, 16.0]]
     )
+
+
+def test_v2_unrelated_evaluator_does_not_widen_topology():
+    """ADVICE r3 (topology.py): a declared evaluator on an UNRELATED
+    branch must not widen a topology built from other outputs (the
+    reference prunes from outputs first, then filters evaluators by the
+    used-layer set — layer.py __get_used_evaluators__)."""
+    paddle.init(use_gpu=False)
+    # branch A: the trained one
+    xa = paddle.layer.data(
+        name="xa", type=paddle.data_type.dense_vector(8)
+    )
+    ya = paddle.layer.data(
+        name="ya", type=paddle.data_type.integer_value(3)
+    )
+    pa = paddle.layer.fc(
+        input=xa, size=3, act=paddle.activation.Softmax()
+    )
+    cost = paddle.layer.classification_cost(input=pa, label=ya)
+    paddle.evaluator.classification_error(input=pa, label=ya)
+    # branch B: fully disjoint, evaluator declared on it
+    xb = paddle.layer.data(
+        name="xb", type=paddle.data_type.dense_vector(4)
+    )
+    yb = paddle.layer.data(
+        name="yb", type=paddle.data_type.integer_value(2)
+    )
+    pb = paddle.layer.fc(
+        input=xb, size=2, act=paddle.activation.Softmax()
+    )
+    paddle.evaluator.classification_error(input=pb, label=yb)
+
+    from paddle.v2.topology import Topology
+
+    topo = Topology(cost)
+    # branch B's layers must not be pulled in; its data layers must not
+    # become required feeds
+    assert set(topo.data_layers()) == {"xa", "ya"}
+    names = {lc.name for lc in topo.proto().layers}
+    assert pb.name not in names and "xb" not in names
+    # only branch A's evaluator survives
+    assert len(topo.evaluator_confs) == 1
+    assert topo.evaluator_confs[0]["input"] == pa.name
+
+
+def test_v2_duplicate_default_evaluator_names_uniquified():
+    """ADVICE r3 (evaluator.py): two same-type evaluator declarations
+    without explicit names must not collide in the metrics dict (the
+    reference config parser auto-uniquifies)."""
+    paddle.init(use_gpu=False)
+    x = paddle.layer.data(
+        name="x", type=paddle.data_type.dense_vector(8)
+    )
+    y = paddle.layer.data(
+        name="y", type=paddle.data_type.integer_value(3)
+    )
+    p1 = paddle.layer.fc(input=x, size=3, act=paddle.activation.Softmax())
+    p2 = paddle.layer.fc(input=x, size=3, act=paddle.activation.Softmax())
+    e1 = paddle.evaluator.classification_error(input=p1, label=y)
+    e2 = paddle.evaluator.classification_error(input=p2, label=y)
+    assert e1["name"] != e2["name"]
+    # list-input declarations uniquify their derived base too
+    paddle.evaluator.classification_error(input=[p1, p2], label=y)
+    paddle.evaluator.classification_error(input=[p1, p2], label=y)
+    names = [ev.get("name") for ev in config_base.EVALUATORS]
+    assert len(names) == len(set(names)), names
