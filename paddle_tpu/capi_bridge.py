@@ -71,17 +71,23 @@ def forward(
     for name, addr, shape, ids in zip(names, addrs, shapes, is_ids):
         n = int(np.prod(shape))
         if ids:
-            buf = (ctypes.c_int32 * n).from_address(addr)
-            arr = np.frombuffer(buf, np.int32).reshape(shape).copy()
-            feed[name] = Arg(ids=arr)
+            feed[name] = Arg(ids=_read_i32(addr, n).reshape(shape))
         else:
-            buf = (ctypes.c_float * n).from_address(addr)
-            arr = np.frombuffer(buf, np.float32).reshape(shape).copy()
-            feed[name] = Arg(value=arr)
+            feed[name] = Arg(value=_read_f32(addr, n).reshape(shape))
+    return _write_output(inf, feed, out_addr, out_capacity)
+
+
+def _write_output(inf, feed: dict, out_addr: int,
+                  out_capacity: int) -> list:
+    """Run inference and copy the first output layer's value into the
+    caller's float buffer; returns the output shape. Rank is capped at
+    8 — the C side writes at most 8 dims into out_shape, so a larger
+    rank must fail loudly rather than return dims the caller can't
+    see."""
     outs = inf.infer(feed)
-    out = np.ascontiguousarray(
-        outs[inf.output_names[0]], np.float32
-    )
+    out = np.ascontiguousarray(outs[inf.output_names[0]], np.float32)
+    if out.ndim > 8:
+        raise ValueError(f"output rank {out.ndim} exceeds the C ABI's 8")
     if out.size > out_capacity:
         raise ValueError(
             f"output needs {out.size} floats, caller buffer has "
@@ -90,6 +96,104 @@ def forward(
     dst = (ctypes.c_float * out.size).from_address(out_addr)
     ctypes.memmove(dst, out.ctypes.data, out.nbytes)
     return list(out.shape)
+
+
+def _read_i32(addr: int, n: int) -> np.ndarray:
+    buf = (ctypes.c_int32 * n).from_address(addr)
+    return np.frombuffer(buf, np.int32).copy()
+
+
+def _read_f32(addr: int, n: int) -> np.ndarray:
+    buf = (ctypes.c_float * n).from_address(addr)
+    return np.frombuffer(buf, np.float32).copy()
+
+
+def _pad_ragged(flat: np.ndarray, pos: np.ndarray):
+    """Flat [total, ...] rows + start positions -> padded [B, T, ...] +
+    [B] lengths. The reference keeps the padding-free layout
+    (Argument.sequenceStartPositions); XLA wants static shapes, so the
+    C boundary is where ragged becomes dense-packed (core/arg.py)."""
+    lens = np.diff(pos).astype(np.int32)
+    b, t = len(lens), int(lens.max(initial=1))
+    out = np.zeros((b, max(t, 1)) + flat.shape[1:], flat.dtype)
+    for i in range(b):
+        out[i, : lens[i]] = flat[pos[i] : pos[i + 1]]
+    return out, lens
+
+
+def _slot_to_arg(s: dict):
+    """One pt_capi_slot (dict of addresses/sizes) -> Arg. Kinds mirror
+    the reference input surface: dense/id matrices (capi/matrix.h,
+    vector.h), sequence start positions incl. one nested level
+    (capi/arguments.h:137), sparse CSR (capi/matrix.h:52,102-114)."""
+    from paddle_tpu.core.arg import Arg, sub_seq
+
+    kind = s["kind"]
+    shape = [int(d) for d in s["shape"]]
+    if kind == 0:  # dense float
+        n = int(np.prod(shape)) if shape else 0
+        return Arg(value=_read_f32(s["buf"], n).reshape(shape))
+    if kind == 1:  # dense ids
+        n = int(np.prod(shape)) if shape else 0
+        return Arg(ids=_read_i32(s["buf"], n).reshape(shape))
+    if kind in (2, 3):  # ragged sequence (ids / dense rows)
+        if not s["seq_pos"] or s["n_seq"] < 2:
+            raise ValueError("sequence slot needs start positions")
+        pos = _read_i32(s["seq_pos"], s["n_seq"])
+        total = int(pos[-1])
+        if kind == 2:
+            flat = _read_i32(s["buf"], total)
+        else:
+            w = int(s["width"])
+            if w <= 0:
+                raise ValueError("PT_SLOT_SEQ_DENSE needs width > 0")
+            flat = _read_f32(s["buf"], total * w).reshape(total, w)
+        if s["subseq_pos"] and s["n_subseq"] >= 2:
+            # nested level: subseq_pos refines the same timestep axis
+            sub = _read_i32(s["subseq_pos"], s["n_subseq"])
+            sub_lens = []
+            for i in range(len(pos) - 1):
+                cuts = sub[(sub >= pos[i]) & (sub <= pos[i + 1])]
+                sub_lens.append(np.diff(cuts).astype(np.int32))
+            smax = max(len(x) for x in sub_lens)
+            padded_sub = np.zeros((len(sub_lens), smax), np.int32)
+            for i, x in enumerate(sub_lens):
+                padded_sub[i, : len(x)] = x
+            # flatten each sequence's timesteps then pad (sub_seq packs
+            # [B, T] with per-subsequence lengths)
+            padded, _ = _pad_ragged(flat, pos)
+            return sub_seq(padded, padded_sub, is_ids=(kind == 2))
+        padded, lens = _pad_ragged(flat, pos)
+        if kind == 2:
+            return Arg(ids=padded, seq_lens=lens)
+        return Arg(value=padded, seq_lens=lens)
+    if kind in (4, 5):  # sparse CSR [height, width] -> dense
+        h, w, nnz = int(s["height"]), int(s["width"]), int(s["nnz"])
+        if w <= 0 or h <= 0:
+            raise ValueError("sparse slot needs height/width > 0")
+        rows = _read_i32(s["rows"], h + 1)
+        cols = _read_i32(s["cols"], nnz)
+        vals = (
+            _read_f32(s["vals"], nnz)
+            if kind == 5
+            else np.ones(nnz, np.float32)
+        )
+        dense = np.zeros((h, w), np.float32)
+        for i in range(h):
+            sl = slice(rows[i], rows[i + 1])
+            dense[i, cols[sl]] = vals[sl]
+        return Arg(value=dense)
+    raise ValueError(f"unknown slot kind {kind}")
+
+
+def forward_slots(h: int, slots: list, out_addr: int,
+                  out_capacity: int) -> list:
+    """Full-surface forward: dense, ids, ragged-sequence (with optional
+    nested level) and sparse CSR input slots. Returns the first output
+    layer's shape; the value is written to out_addr (float32)."""
+    inf = _HANDLES[h]
+    feed = {s["name"]: _slot_to_arg(s) for s in slots}
+    return _write_output(inf, feed, out_addr, out_capacity)
 
 
 def destroy(h: int) -> None:

@@ -17,6 +17,8 @@
 #include <mutex>
 #include <string>
 
+#include "../include/pt_capi.h"
+
 namespace {
 
 std::mutex g_mu;
@@ -199,6 +201,74 @@ int pt_capi_forward(int64_t handle, const char** names, const void** bufs,
   Py_DECREF(py_ids);
   if (!r) {
     set_error("forward failed");
+    return -1;
+  }
+  int rank = (int)PyList_Size(r);
+  for (int d = 0; d < rank && d < 8; ++d)
+    out_shape[d] = PyLong_AsLongLong(PyList_GetItem(r, d));
+  Py_DECREF(r);
+  return rank;
+}
+
+// Full-surface forward: sequence (ragged ids / dense rows + start
+// positions, optional nested level) and sparse CSR slots — the
+// reference C API's paddle_arguments_set_sequence_start_pos
+// (capi/arguments.h:137) and paddle_matrix_create_sparse /
+// paddle_matrix_sparse_copy_from (capi/matrix.h:52,102) surface.
+// Marshaling stays in capi_bridge.forward_slots; here each slot is
+// packed into a dict of addresses/sizes.
+int pt_capi_forward_slots(int64_t handle, const pt_capi_slot* slots,
+                          int n_slots, float* out_buf, int64_t out_cap,
+                          int64_t* out_shape) {
+  Gil gil;
+  PyObject* py_slots = PyList_New(n_slots);
+  if (!py_slots) {
+    set_error("forward_slots: allocation failed");
+    return -1;
+  }
+  bool ok = true;
+  for (int i = 0; ok && i < n_slots; ++i) {
+    const pt_capi_slot& s = slots[i];
+    PyObject* shp = PyList_New(s.ndims > 0 ? s.ndims : 0);
+    for (int d = 0; shp && d < s.ndims; ++d) {
+      PyObject* dim = PyLong_FromLongLong(s.shape[d]);
+      if (!dim) {
+        Py_CLEAR(shp);
+        break;
+      }
+      PyList_SetItem(shp, d, dim);
+    }
+    PyObject* dict =
+        shp ? Py_BuildValue(
+                  "{s:s, s:i, s:L, s:N, s:L, s:i, s:L, s:i, s:L, s:L, "
+                  "s:L, s:L, s:L, s:L}",
+                  "name", s.name ? s.name : "", "kind", s.kind, "buf",
+                  (long long)(intptr_t)s.buf, "shape", shp, "seq_pos",
+                  (long long)(intptr_t)s.seq_pos, "n_seq", s.n_seq,
+                  "subseq_pos", (long long)(intptr_t)s.subseq_pos,
+                  "n_subseq", s.n_subseq, "width", (long long)s.width,
+                  "rows", (long long)(intptr_t)s.rows, "cols",
+                  (long long)(intptr_t)s.cols, "vals",
+                  (long long)(intptr_t)s.vals, "height",
+                  (long long)s.height, "nnz", (long long)s.nnz)
+            : nullptr;
+    if (!dict) {
+      ok = false;
+      break;
+    }
+    PyList_SetItem(py_slots, i, dict);
+  }
+  if (!ok) {
+    Py_DECREF(py_slots);
+    set_error("forward_slots: allocation failed");
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(
+      bridge(), "forward_slots", "LOLL", (long long)handle, py_slots,
+      (long long)(intptr_t)out_buf, (long long)out_cap);
+  Py_DECREF(py_slots);
+  if (!r) {
+    set_error("forward_slots failed");
     return -1;
   }
   int rank = (int)PyList_Size(r);
