@@ -1,0 +1,22 @@
+"""paddle.utils — operator-facing tool scripts.
+
+Reference: python/paddle/utils/ — plotcurve, dump_config,
+make_model_diagram, show_pb, image_util, preprocess_img. Each module
+here is runnable (`python -m paddle.utils.plotcurve ...`) and delegates
+to the paddle_tpu machinery (plot/make_diagram/config/
+data.proto_provider/image).
+
+Deliberately out of scope (documented, like PARITY.md scope-outs):
+torch2paddle (torch-binary weight import — the tar interop in
+paddle.v2.parameters covers model exchange) and image_multiproc (the
+feeder's prefetch covers the multi-process decode role).
+"""
+
+__all__ = [
+    "dump_config",
+    "image_util",
+    "make_model_diagram",
+    "plotcurve",
+    "preprocess_img",
+    "show_pb",
+]

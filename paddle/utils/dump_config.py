@@ -1,0 +1,35 @@
+"""Print a parsed model config.
+
+Reference: python/paddle/utils/dump_config.py (parse a trainer config
+and print the TrainerConfig proto). Works on v1 trainer configs and
+paddle_tpu get_config modules alike — delegates to the CLI's
+dump_config verb.
+
+usage: python -m paddle.utils.dump_config CONFIG [CONFIG_ARGS]
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        sys.stderr.write(
+            "usage: python -m paddle.utils.dump_config CONFIG "
+            "[CONFIG_ARGS]\n"
+        )
+        return 1
+    from paddle_tpu.__main__ import main as cli_main
+
+    args = ["dump_config", "--config", argv[0]]
+    if len(argv) > 1:
+        args += ["--config_args", argv[1]]
+    return cli_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

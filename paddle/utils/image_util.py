@@ -1,0 +1,210 @@
+"""Image helpers for dataset preprocessing and serving feeds.
+
+Reference: python/paddle/utils/image_util.py — PIL/ndarray helpers
+(shorter-edge resize, crop with padding, flips, 10-crop oversampling,
+mean-image handling, ImageTransformer). Implemented fresh over numpy +
+PIL with the same call signatures; the newer v2-style transforms live
+in paddle_tpu.image (paddle.v2.image).
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = [
+    "resize_image",
+    "flip",
+    "crop_img",
+    "decode_jpeg",
+    "preprocess_img",
+    "load_meta",
+    "load_image",
+    "oversample",
+    "ImageTransformer",
+]
+
+
+def _pil():
+    from PIL import Image
+
+    return Image
+
+
+def resize_image(img, target_size):
+    """Resize a PIL image so its shorter edge equals target_size."""
+    Image = _pil()
+    scale = target_size / float(min(img.size))
+    new_size = (
+        int(round(img.size[0] * scale)),
+        int(round(img.size[1] * scale)),
+    )
+    return img.resize(new_size, Image.LANCZOS)
+
+
+def flip(im: np.ndarray) -> np.ndarray:
+    """Horizontal flip; im is HxW or KxHxW (last axis = width)."""
+    return im[..., ::-1]
+
+
+def crop_img(im: np.ndarray, inner_size: int, color: bool = True,
+             test: bool = True) -> np.ndarray:
+    """inner_size x inner_size crop of a (K,H,W) (color) or (H,W)
+    array, zero-padding images smaller than the crop. test=True crops
+    the center; test=False crops randomly and flips half the time."""
+    im = np.asarray(im, np.float32)
+    h_ax, w_ax = (1, 2) if color else (0, 1)
+    height = max(inner_size, im.shape[h_ax])
+    width = max(inner_size, im.shape[w_ax])
+    pad_shape = (
+        (im.shape[0], height, width) if color else (height, width)
+    )
+    padded = np.zeros(pad_shape, np.float32)
+    y0 = (height - im.shape[h_ax]) // 2
+    x0 = (width - im.shape[w_ax]) // 2
+    sl = (
+        np.s_[:, y0 : y0 + im.shape[1], x0 : x0 + im.shape[2]]
+        if color
+        else np.s_[y0 : y0 + im.shape[0], x0 : x0 + im.shape[1]]
+    )
+    padded[sl] = im
+    if test:
+        y = (height - inner_size) // 2
+        x = (width - inner_size) // 2
+    else:
+        y = np.random.randint(0, height - inner_size + 1)
+        x = np.random.randint(0, width - inner_size + 1)
+    out = (
+        padded[:, y : y + inner_size, x : x + inner_size]
+        if color
+        else padded[y : y + inner_size, x : x + inner_size]
+    )
+    if not test and np.random.randint(2) == 0:
+        out = flip(out)
+    return out
+
+
+def decode_jpeg(jpeg_bytes: bytes) -> np.ndarray:
+    """JPEG bytes -> (K,H,W) (color) or (H,W) ndarray."""
+    Image = _pil()
+    arr = np.array(Image.open(io.BytesIO(jpeg_bytes)))
+    if arr.ndim == 3:
+        arr = np.transpose(arr, (2, 0, 1))
+    return arr
+
+
+def preprocess_img(im: np.ndarray, img_mean: np.ndarray,
+                   crop_size: int, is_train: bool,
+                   color: bool = True) -> np.ndarray:
+    """Crop (+augment when training), subtract the mean image, and
+    flatten to the trainer's dense-vector layout."""
+    pic = crop_img(
+        np.asarray(im, np.float32), crop_size, color, test=not is_train
+    )
+    return (pic - img_mean).flatten()
+
+
+def load_meta(meta_path: str, mean_img_size: int, crop_size: int,
+              color: bool = True) -> np.ndarray:
+    """Load the dataset mean image and center-crop it to crop_size.
+    The meta file is either an npz or a pickled dict (what
+    preprocess_img writes) with a 'data_mean' entry."""
+    try:
+        meta = np.load(meta_path, allow_pickle=True)
+    except (OSError, ValueError):
+        import pickle
+
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+    mean = np.asarray(meta["data_mean"]).reshape(-1)
+    border = (mean_img_size - crop_size) // 2
+    if color:
+        assert mean_img_size * mean_img_size * 3 == mean.shape[0]
+        mean = mean.reshape(3, mean_img_size, mean_img_size)
+        out = mean[
+            :, border : border + crop_size, border : border + crop_size
+        ]
+    else:
+        assert mean_img_size * mean_img_size == mean.shape[0]
+        mean = mean.reshape(mean_img_size, mean_img_size)
+        out = mean[
+            border : border + crop_size, border : border + crop_size
+        ]
+    return out.astype(np.float32)
+
+
+def load_image(img_path: str, is_color: bool = True):
+    """Load a PIL image (is_color selects RGB vs L on convert)."""
+    Image = _pil()
+    img = Image.open(img_path)
+    img.load()
+    return img.convert("RGB" if is_color else "L")
+
+
+def oversample(imgs, crop_dims):
+    """Ten crops per image — 4 corners + center, and their mirrors.
+    imgs: iterable of (H,W,K) arrays; returns [10*N, ch, cw, K]."""
+    imgs = list(imgs)
+    im_shape = np.array(imgs[0].shape)
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    ys = (0, im_shape[0] - ch)
+    xs = (0, im_shape[1] - cw)
+    boxes = [(y, x) for y in ys for x in xs]
+    cy = int(round((im_shape[0] - ch) / 2.0))
+    cx = int(round((im_shape[1] - cw) / 2.0))
+    boxes.append((cy, cx))
+    out = np.empty((10 * len(imgs), ch, cw, im_shape[-1]), np.float32)
+    i = 0
+    for im in imgs:
+        for y, x in boxes:
+            out[i] = im[y : y + ch, x : x + cw, :]
+            i += 1
+        out[i : i + 5] = out[i - 5 : i, :, ::-1, :]  # mirrors
+        i += 5
+    return out
+
+
+class ImageTransformer:
+    """Channel-order / transpose / mean pipeline for serving feeds
+    (reference ImageTransformer: set_transpose, set_channel_swap,
+    set_mean, transformer)."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color: bool = True):
+        self.is_color = is_color
+        self.transpose = None
+        self.channel_swap = None
+        self.mean = None
+        if transpose is not None:
+            self.set_transpose(transpose)
+        if channel_swap is not None:
+            self.set_channel_swap(channel_swap)
+        if mean is not None:
+            self.set_mean(mean)
+
+    def set_transpose(self, order):
+        if self.is_color:
+            assert len(order) == 3
+        self.transpose = tuple(order)
+
+    def set_channel_swap(self, order):
+        if self.is_color:
+            assert len(order) == 3
+        self.channel_swap = tuple(order)
+
+    def set_mean(self, mean):
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1:  # per-channel mean -> broadcastable (K,1,1)
+            mean = mean[:, np.newaxis, np.newaxis]
+        self.mean = mean
+
+    def transformer(self, data: np.ndarray) -> np.ndarray:
+        out = np.asarray(data, np.float32)
+        if self.transpose is not None:
+            out = out.transpose(self.transpose)
+        if self.channel_swap is not None:
+            out = out[self.channel_swap, :, :]
+        if self.mean is not None:
+            out = out - self.mean
+        return out

@@ -1,0 +1,33 @@
+"""Emit a graphviz diagram of a model config.
+
+Reference: python/paddle/utils/make_model_diagram.py (config -> .dot).
+Delegates to paddle_tpu.plot.make_diagram via the CLI verb.
+
+usage: python -m paddle.utils.make_model_diagram CONFIG [OUT.dot]
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["main"]
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        sys.stderr.write(
+            "usage: python -m paddle.utils.make_model_diagram CONFIG "
+            "[OUT.dot]\n"
+        )
+        return 1
+    from paddle_tpu.__main__ import main as cli_main
+
+    args = ["make_diagram", "--config", argv[0]]
+    if len(argv) > 1:
+        args += ["--output", argv[1]]
+    return cli_main(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

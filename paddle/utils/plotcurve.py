@@ -1,0 +1,95 @@
+"""Plot training/testing curves from a trainer log.
+
+Reference: python/paddle/utils/plotcurve.py — reads a paddle log from a
+file or stdin, extracts `key=value` scores for the requested keys
+(default AvgCost), separates the pass-test segments, and writes a
+matplotlib figure to a file or stdout.
+
+usage: python -m paddle.utils.plotcurve [-i LOG] [-o FIG.png] [key ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+__all__ = ["plot_paddle_curve", "main"]
+
+
+def _extract(keys, lines):
+    """{key: ([train values], [test values])} in log order."""
+    out = {k: ([], []) for k in keys}
+    for line in lines:
+        is_test = "pass-test" in line or "Test samples" in line or (
+            "Test" in line and "=" in line
+        )
+        for k in keys:
+            for m in re.finditer(
+                rf"{re.escape(k)}=([-+0-9.eE]+)", line
+            ):
+                out[k][1 if is_test else 0].append(float(m.group(1)))
+    return out
+
+
+def plot_paddle_curve(keys, inputfile, outputfile, format="png",
+                      show_fig=False):
+    """Render one curve per key (train solid, test dashed) to
+    `outputfile` (a path or binary file object)."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+
+    data = _extract(keys, inputfile)
+    if not any(tr or te for tr, te in data.values()):
+        sys.stderr.write("no matching score keys found in the log\n")
+        return 1
+    plt.figure(figsize=(8, 5))
+    for k, (train, test) in data.items():
+        if train:
+            plt.plot(range(len(train)), train, label=f"{k} (train)")
+        if test:
+            plt.plot(
+                range(len(test)), test, "--", label=f"{k} (test)"
+            )
+    plt.xlabel("log point")
+    plt.legend()
+    plt.grid(True, alpha=0.3)
+    if hasattr(outputfile, "write"):
+        plt.savefig(outputfile, format=format)
+    else:
+        plt.savefig(outputfile)
+    plt.close()
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Plot training and testing curves from a trainer "
+        "log file."
+    )
+    p.add_argument("-i", "--input", default=None,
+                   help="log file (default: stdin)")
+    p.add_argument("-o", "--output", default=None,
+                   help="figure file (default: stdout)")
+    p.add_argument("--format", default="png",
+                   help="figure format (png|pdf|ps|eps|svg)")
+    p.add_argument("key", nargs="*", default=[],
+                   help="score keys to plot (default AvgCost)")
+    a = p.parse_args(argv)
+    keys = a.key or ["AvgCost"]
+    inp = open(a.input) if a.input else sys.stdin
+    try:
+        if a.output:
+            return plot_paddle_curve(keys, inp, a.output, a.format)
+        return plot_paddle_curve(
+            keys, inp, sys.stdout.buffer, a.format
+        )
+    finally:
+        if a.input:
+            inp.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

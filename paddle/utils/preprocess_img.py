@@ -1,0 +1,149 @@
+"""Preprocess an image-classification dataset directory into batches.
+
+Reference: python/paddle/utils/preprocess_img.py (+ preprocess_util) —
+walk a directory whose sub-directories are label names, resize every
+image, split train/test, write pickled batch files plus a meta file
+holding the dataset mean image (what image_util.load_meta reads) and a
+labels list. The batch layout feeds the image dataprovider the same
+way the reference's batches did.
+
+usage: python -m paddle.utils.preprocess_img -i DATA_DIR
+           [-s TARGET_SIZE] [-c IS_COLOR] [-n TEST_RATIO]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import sys
+
+import numpy as np
+
+from paddle.utils.image_util import load_image, resize_image
+
+__all__ = ["ImageClassificationDatasetCreater", "main"]
+
+_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif")
+
+
+class ImageClassificationDatasetCreater:
+    """data_path: directory of <label>/<image> files. Produces
+    data_path/batches/{batches_train/,batches_test/,labels.txt,
+    batches.meta} (meta holds data_mean, the flattened mean image)."""
+
+    def __init__(self, data_path: str, target_size: int,
+                 color: bool = True, num_per_batch: int = 1024,
+                 test_ratio: float = 0.1):
+        self.data_path = data_path
+        self.target_size = target_size
+        self.color = color
+        self.num_per_batch = num_per_batch
+        self.test_ratio = test_ratio
+
+    def _load_one(self, path: str) -> np.ndarray:
+        img = resize_image(
+            load_image(path, self.color), self.target_size
+        )
+        arr = np.array(img)
+        # center-crop to square target_size x target_size
+        h, w = arr.shape[:2]
+        y = (h - self.target_size) // 2
+        x = (w - self.target_size) // 2
+        arr = arr[y : y + self.target_size, x : x + self.target_size]
+        if self.color:  # HWC -> flattened CHW (trainer layout)
+            arr = arr.transpose(2, 0, 1)
+        return arr.astype(np.float32).flatten()
+
+    def create_dataset_from_dir(self, path: str = None) -> str:
+        path = path or self.data_path
+        labels = sorted(
+            d for d in os.listdir(path)
+            if os.path.isdir(os.path.join(path, d))
+        )
+        if not labels:
+            raise ValueError(f"no label sub-directories under {path}")
+        samples = []
+        for li, label in enumerate(labels):
+            for fn in sorted(os.listdir(os.path.join(path, label))):
+                if fn.lower().endswith(_EXTS):
+                    samples.append(
+                        (os.path.join(path, label, fn), li)
+                    )
+        rng = np.random.default_rng(0)
+        rng.shuffle(samples)
+        n_test = int(len(samples) * self.test_ratio)
+        if n_test >= len(samples):
+            raise ValueError(
+                f"no training samples: {len(samples)} images found "
+                f"under {path} with test_ratio={self.test_ratio}"
+            )
+        splits = {
+            "test": samples[:n_test],
+            "train": samples[n_test:],
+        }
+        out_dir = os.path.join(path, "batches")
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "labels.txt"), "w") as f:
+            for li, label in enumerate(labels):
+                f.write(f"{li} {label}\n")
+        mean_acc, mean_n = None, 0
+        for split, items in splits.items():
+            split_dir = os.path.join(out_dir, f"batches_{split}")
+            os.makedirs(split_dir, exist_ok=True)
+            names = []
+            for start in range(0, len(items), self.num_per_batch):
+                chunk = items[start : start + self.num_per_batch]
+                data = np.stack(
+                    [self._load_one(p) for p, _ in chunk]
+                )
+                lab = np.asarray([l for _, l in chunk], np.int32)
+                bname = f"batch_{start // self.num_per_batch:05d}"
+                with open(os.path.join(split_dir, bname), "wb") as f:
+                    pickle.dump(
+                        {"data": data, "labels": lab}, f, protocol=2
+                    )
+                names.append(os.path.join(split_dir, bname))
+                if split == "train":
+                    s = data.sum(axis=0)
+                    mean_acc = s if mean_acc is None else mean_acc + s
+                    mean_n += len(chunk)
+            with open(
+                os.path.join(out_dir, f"{split}.list"), "w"
+            ) as f:
+                f.write("\n".join(names) + ("\n" if names else ""))
+        meta = {
+            "data_mean": (
+                mean_acc / max(mean_n, 1)
+            ).astype(np.float32),
+            "image_size": self.target_size,
+            "color": self.color,
+            "num_classes": len(labels),
+        }
+        with open(os.path.join(out_dir, "batches.meta"), "wb") as f:
+            pickle.dump(meta, f, protocol=2)
+        return out_dir
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Preprocess an image dataset directory into "
+        "train/test batches + mean-image meta."
+    )
+    p.add_argument("-i", "--input", required=True,
+                   help="dataset dir (sub-dirs are labels)")
+    p.add_argument("-s", "--size", type=int, default=32,
+                   help="target image size")
+    p.add_argument("-c", "--color", type=int, default=1)
+    p.add_argument("-n", "--test_ratio", type=float, default=0.1)
+    a = p.parse_args(argv)
+    creater = ImageClassificationDatasetCreater(
+        a.input, a.size, bool(a.color), test_ratio=a.test_ratio
+    )
+    out = creater.create_dataset_from_dir()
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

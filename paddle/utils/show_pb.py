@@ -1,0 +1,62 @@
+"""Print the DataHeader and DataSamples of a binary proto data file.
+
+Reference: python/paddle/utils/show_pb.py — reads the varint-delimited
+DataFormat.proto stream (proto/DataFormat.proto) and prints each
+message. The wire decoding lives in paddle_tpu.data.proto_provider
+(the same codec the ProtoDataProvider uses).
+
+usage: python -m paddle.utils.show_pb PROTO_DATA_FILE
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["show", "main"]
+
+_SLOT_NAMES = {
+    0: "VECTOR_DENSE",
+    1: "VECTOR_SPARSE_NON_VALUE",
+    2: "VECTOR_SPARSE_VALUE",
+    3: "INDEX",
+    4: "VAR_MDIM_DENSE",
+    5: "VAR_MDIM_INDEX",
+    6: "STRING",
+}
+
+
+def show(path: str, out=None) -> int:
+    from paddle_tpu.data.proto_provider import read_proto_data_raw
+
+    out = out or sys.stdout
+    header, rows, begins = read_proto_data_raw(path)
+    out.write("DataHeader {\n")
+    for t, dim in header:
+        out.write(
+            f"  slot_defs {{ type: {_SLOT_NAMES.get(t, t)} "
+            f"dim: {dim} }}\n"
+        )
+    out.write("}\n")
+    for row, beg in zip(rows, begins):
+        out.write("DataSample {\n")
+        out.write(f"  is_beginning: {str(bool(beg)).lower()}\n")
+        for (t, _dim), slot in zip(header, row):
+            out.write(
+                f"  {_SLOT_NAMES.get(t, t).lower()}: {slot!r}\n"
+            )
+        out.write("}\n")
+    return 0
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) != 1:
+        sys.stderr.write(
+            "usage: python -m paddle.utils.show_pb PROTO_DATA_FILE\n"
+        )
+        return 1
+    return show(argv[0])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

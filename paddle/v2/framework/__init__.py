@@ -1,0 +1,12 @@
+"""paddle.v2.framework — the new-op-framework namespace.
+
+Reference: python/paddle/v2/framework/__init__.py (which exposes the
+pybind `core` module, the Operator factory in `op.py`, and
+`default_scope_funcs`). Here the engine is paddle_tpu.framework
+(pure-jax op kernels over Scopes — SURVEY.md §2 rows 25-26); this
+namespace reproduces the reference's user-facing module layout,
+including the generic test harness (`gradient_checker`,
+`op_test_util`) that reference op tests import.
+"""
+
+__all__ = ["core", "op", "default_scope_funcs"]

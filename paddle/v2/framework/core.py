@@ -1,0 +1,51 @@
+"""paddle.v2.framework.core — the engine-object module.
+
+Reference: the pybind module `core` (paddle/framework/pybind.cc) exposing
+Scope, places and Operator.backward. The TPU engine keeps values as jax
+arrays inside paddle_tpu Scopes, so tensors need no set_dims/alloc
+choreography — `new_var` + `set_value`/numpy round-trips cover the same
+test surface.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.framework.backward import backward as _build_backward
+from paddle_tpu.framework.scope import Variable  # noqa: F401
+from paddle_tpu.framework.scope import Scope as _Scope
+
+
+class Scope(_Scope):
+    """Reference core.Scope surface (framework/scope.h:36):
+    new_var/find_var/new_scope/drop_kids."""
+
+    def new_var(self, name: str) -> Variable:
+        return self.var(name)
+
+    def drop_kids(self) -> None:
+        # child scopes are plain Python objects; dropping the
+        # references is the whole job (scope.h DropKids frees C++ kids)
+        self._kids.clear()
+
+
+class CPUPlace:
+    """Single-host place marker (platform/place.h). Kernels are jax —
+    the actual device is whatever backend jax runs on (TPU under jit,
+    CPU in the eager test harness)."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "CPUPlace"
+
+
+def is_compile_gpu() -> bool:
+    """The reference gates GPUPlace test arms on this; the TPU build
+    has no CUDA arm."""
+    return False
+
+
+class Operator:
+    """core.Operator static surface used by tests:
+    Operator.backward(fwd_op, no_grad_set) -> backward net."""
+
+    @staticmethod
+    def backward(forward_op, no_grad_set=frozenset()):
+        return _build_backward(forward_op, set(no_grad_set))

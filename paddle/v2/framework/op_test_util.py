@@ -1,0 +1,55 @@
+"""OpTestMeta — declarative per-op forward tests.
+
+Reference: python/paddle/v2/framework/tests/op_test_util.py — a
+metaclass injecting `test_all` into a TestCase: the subclass declares
+`self.type`, `self.inputs`, `self.outputs` (and optionally
+`self.attrs`) in setUp; test_all builds the op by slot name, runs it
+in a fresh scope, and compares every declared output.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle.v2.framework.core import Scope
+from paddle.v2.framework.op import Operator
+
+__all__ = ["OpTestMeta"]
+
+
+class OpTestMeta(type):
+    def __new__(cls, name, bases, attrs):
+        obj = super().__new__(cls, name, bases, attrs)
+
+        def test_all(self):
+            scope = Scope()
+            kwargs = {}
+            for in_name in Operator.get_op_input_names(self.type):
+                if hasattr(self, "inputs") and in_name in self.inputs:
+                    kwargs[in_name] = in_name
+                    scope.set(in_name, np.asarray(self.inputs[in_name]))
+            for out_name in Operator.get_op_output_names(self.type):
+                if not hasattr(self, "outputs"):
+                    raise ValueError("the test op must set self.outputs")
+                if out_name not in self.outputs:
+                    raise ValueError(
+                        f"{out_name} is not in self.outputs"
+                    )
+                kwargs[out_name] = out_name
+            for attr_name in Operator.get_op_attr_names(self.type):
+                if hasattr(self, "attrs") and attr_name in self.attrs:
+                    kwargs[attr_name] = self.attrs[attr_name]
+
+            op = Operator(self.type, **kwargs)
+            op.run(scope)
+
+            for out_name in Operator.get_op_output_names(self.type):
+                actual = np.asarray(scope.get(out_name))
+                expect = np.asarray(self.outputs[out_name])
+                np.testing.assert_allclose(
+                    actual, expect, rtol=1e-4, atol=1e-5,
+                    err_msg=f"output {out_name} has diff",
+                )
+
+        obj.test_all = test_all
+        return obj

@@ -1,0 +1,7 @@
+"""Reference import location for the op-test harness
+(python/paddle/v2/framework/tests/): re-exports the reusable modules so
+`from paddle.v2.framework.tests import gradient_checker` and
+reference-style `from paddle.v2.framework.tests.op_test_util import
+OpTestMeta` both resolve."""
+
+from paddle.v2.framework import gradient_checker, op_test_util  # noqa: F401

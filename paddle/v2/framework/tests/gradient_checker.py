@@ -1,0 +1,7 @@
+from paddle.v2.framework.gradient_checker import *  # noqa: F401,F403
+from paddle.v2.framework.gradient_checker import (  # noqa: F401
+    GradientChecker,
+    create_op,
+    get_numeric_gradient,
+    grad_var_name,
+)

@@ -1,0 +1,1 @@
+from paddle.v2.framework.op_test_util import OpTestMeta  # noqa: F401

@@ -108,25 +108,12 @@ def _read_f32(addr: int, n: int) -> np.ndarray:
     return np.frombuffer(buf, np.float32).copy()
 
 
-def _pad_ragged(flat: np.ndarray, pos: np.ndarray):
-    """Flat [total, ...] rows + start positions -> padded [B, T, ...] +
-    [B] lengths. The reference keeps the padding-free layout
-    (Argument.sequenceStartPositions); XLA wants static shapes, so the
-    C boundary is where ragged becomes dense-packed (core/arg.py)."""
-    lens = np.diff(pos).astype(np.int32)
-    b, t = len(lens), int(lens.max(initial=1))
-    out = np.zeros((b, max(t, 1)) + flat.shape[1:], flat.dtype)
-    for i in range(b):
-        out[i, : lens[i]] = flat[pos[i] : pos[i + 1]]
-    return out, lens
-
-
 def _slot_to_arg(s: dict):
     """One pt_capi_slot (dict of addresses/sizes) -> Arg. Kinds mirror
     the reference input surface: dense/id matrices (capi/matrix.h,
     vector.h), sequence start positions incl. one nested level
     (capi/arguments.h:137), sparse CSR (capi/matrix.h:52,102-114)."""
-    from paddle_tpu.core.arg import Arg, sub_seq
+    from paddle_tpu.core.arg import Arg, pad_ragged, sub_seq
 
     kind = s["kind"]
     shape = [int(d) for d in s["shape"]]
@@ -149,8 +136,20 @@ def _slot_to_arg(s: dict):
                 raise ValueError("PT_SLOT_SEQ_DENSE needs width > 0")
             flat = _read_f32(s["buf"], total * w).reshape(total, w)
         if s["subseq_pos"] and s["n_subseq"] >= 2:
-            # nested level: subseq_pos refines the same timestep axis
+            # nested level: subseq_pos refines the same timestep axis,
+            # so it must be a superset of seq_pos's boundaries — a
+            # malformed refinement would silently mask real timesteps
             sub = _read_i32(s["subseq_pos"], s["n_subseq"])
+            if not np.isin(pos, sub).all():
+                raise ValueError(
+                    "subseq start positions must include every "
+                    f"sequence boundary: seq_pos={pos.tolist()}, "
+                    f"subseq_pos={sub.tolist()}"
+                )
+            if not (np.diff(sub) > 0).all():
+                raise ValueError(
+                    "subseq start positions must be strictly increasing"
+                )
             sub_lens = []
             for i in range(len(pos) - 1):
                 cuts = sub[(sub >= pos[i]) & (sub <= pos[i + 1])]
@@ -161,9 +160,9 @@ def _slot_to_arg(s: dict):
                 padded_sub[i, : len(x)] = x
             # flatten each sequence's timesteps then pad (sub_seq packs
             # [B, T] with per-subsequence lengths)
-            padded, _ = _pad_ragged(flat, pos)
+            padded, _ = pad_ragged(flat, pos)
             return sub_seq(padded, padded_sub, is_ids=(kind == 2))
-        padded, lens = _pad_ragged(flat, pos)
+        padded, lens = pad_ragged(flat, pos)
         if kind == 2:
             return Arg(ids=padded, seq_lens=lens)
         return Arg(value=padded, seq_lens=lens)

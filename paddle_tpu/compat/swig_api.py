@@ -28,7 +28,7 @@ import numpy as np
 
 from paddle_tpu.core import flags as _flags
 from paddle_tpu.core import rng as _rng
-from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.arg import Arg, pad_ragged
 from paddle_tpu.network import Network
 from paddle_tpu.optimizers import create_optimizer
 from paddle_tpu.parallel.dp import TrainStep
@@ -224,22 +224,12 @@ class Arguments:
             ids = s["ids"].copyToNumpyArray()
             if starts is None:
                 return Arg(ids=ids)
-            st = starts.copyToNumpyArray()
-            lens = np.diff(st).astype(np.int32)
-            b, t = len(lens), int(lens.max(initial=1))
-            out = np.zeros((b, t), np.int32)
-            for j, (lo, hi) in enumerate(zip(st[:-1], st[1:])):
-                out[j, : hi - lo] = ids[lo:hi]
+            out, lens = pad_ragged(ids, starts.copyToNumpyArray())
             return Arg(ids=out, seq_lens=lens)
         v = s["value"].copyToNumpyMat()
         if starts is None:
             return Arg(value=v)
-        st = starts.copyToNumpyArray()
-        lens = np.diff(st).astype(np.int32)
-        b, t = len(lens), int(lens.max(initial=1))
-        out = np.zeros((b, t, v.shape[1]), np.float32)
-        for j, (lo, hi) in enumerate(zip(st[:-1], st[1:])):
-            out[j, : hi - lo] = v[lo:hi]
+        out, lens = pad_ragged(v, starts.copyToNumpyArray())
         return Arg(value=out, seq_lens=lens)
 
     def _feed(self, names) -> dict:

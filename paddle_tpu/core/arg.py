@@ -76,6 +76,23 @@ class Arg:
         return jnp.sum(self.seq_lens)
 
 
+def pad_ragged(flat, pos):
+    """Flat [total, ...] rows + start positions (len B+1, first 0,
+    last total) -> (padded [B, T, ...], lens [B]). The reference keeps
+    the padding-free layout (Argument.sequenceStartPositions); XLA
+    wants static shapes, so API boundaries (C ABI, SWIG compat) convert
+    ragged to dense-packed here."""
+    import numpy as np
+
+    pos = np.asarray(pos)
+    lens = np.diff(pos).astype(np.int32)
+    b, t = len(lens), int(lens.max(initial=1))
+    out = np.zeros((b, max(t, 1)) + flat.shape[1:], flat.dtype)
+    for i in range(b):
+        out[i, : lens[i]] = flat[pos[i] : pos[i + 1]]
+    return out, lens
+
+
 def non_seq(value: jax.Array) -> Arg:
     return Arg(value=value)
 

@@ -59,6 +59,12 @@ class OperatorBase:
     (framework/operator.h:63,90)."""
 
     type: str = "base"
+    # OpProto-style slot signature (framework/op_registry.h OpProto):
+    # declared per registered op via set_signature; introspected by the
+    # v2 Operator facade and the generic op-test harness.
+    INPUT_SLOTS: tuple = ()
+    OUTPUT_SLOTS: tuple = ()
+    ATTR_NAMES: tuple = ()
 
     def __init__(self, inputs=None, outputs=None, attrs=None):
         self.inputs: VarMap = _as_varmap(inputs)
@@ -116,6 +122,29 @@ def create_op(type_name: str, inputs=None, outputs=None, attrs=None):
         known = ", ".join(sorted(_OPS))
         raise KeyError(f"unknown op type {type_name!r}; registered: {known}")
     return _OPS[type_name](inputs=inputs, outputs=outputs, attrs=attrs)
+
+
+def set_signature(type_name: str, input_slots, output_slots,
+                  attr_names=()):
+    """Attach the OpProto slot signature to a registered op."""
+    cls = _OPS[type_name]
+    cls.INPUT_SLOTS = tuple(input_slots)
+    cls.OUTPUT_SLOTS = tuple(output_slots)
+    cls.ATTR_NAMES = tuple(attr_names)
+
+
+def op_types() -> List[str]:
+    """All registered op type names (OpRegistry enumeration)."""
+    return sorted(_OPS)
+
+
+def op_signature(type_name: str):
+    """(input_slots, output_slots, attr_names) of a registered op —
+    the role of the reference's OpProto / get_all_op_protos()."""
+    if type_name not in _OPS:
+        raise KeyError(f"unknown op type {type_name!r}")
+    cls = _OPS[type_name]
+    return cls.INPUT_SLOTS, cls.OUTPUT_SLOTS, cls.ATTR_NAMES
 
 
 def grad_op_for(op: OperatorBase) -> List[OperatorBase]:

@@ -19,6 +19,7 @@ from paddle_tpu.framework.op import (
     create_op,
     register_grad,
     register_op,
+    set_signature,
 )
 
 
@@ -363,3 +364,37 @@ def _scatter_grad(op):
             "gather", {"X": _g(out), "Index": idx}, {"Out": _g(upd)}
         ),
     ]
+
+
+# --------------------------------------------------- slot signatures
+# OpProto declarations (framework/op_registry.h: each op's Maker names
+# its input/output slots and attributes). The v2 Operator facade
+# (paddle.v2.framework.op) and the generic op-test/gradient-check
+# harness build ops by slot name from these.
+for _name, _sig in {
+    "add": (("X", "Y"), ("Out",)),
+    "identity": (("X",), ("Out",)),
+    "reduce_to_shape_of": (("X", "Like"), ("Out",)),
+    "sum": (("X",), ("Out",)),
+    "mul": (("X", "Y"), ("Out",)),
+    "matmul_nt": (("X", "Y"), ("Out",)),
+    "matmul_tn": (("X", "Y"), ("Out",)),
+    "mean": (("X",), ("Out",)),
+    "mean_grad": (("X", "Out@G"), ("Out",)),
+    "scale": (("X",), ("Out",), ("scale",)),
+    "sigmoid": (("X",), ("Y",)),
+    "sigmoid_grad": (("Y", "Y@G"), ("Out",)),
+    "softmax": (("X",), ("Y",)),
+    "softmax_grad": (("Y", "Y@G"), ("Out",)),
+    "onehot_cross_entropy": (("X", "label"), ("Y",)),
+    "onehot_cross_entropy_grad": (("X", "label", "Y@G"), ("Out",)),
+    "rowwise_add": (("X", "b"), ("Out",)),
+    "sgd": (("param", "grad"), ("param_out",), ("learning_rate",)),
+    "fill_zeros_like": (("Src",), ("Dst",)),
+    "gaussian_random": ((), ("Out",), ("dims", "mean", "std", "seed")),
+    "uniform_random": ((), ("Out",), ("dims", "min", "max", "seed")),
+    "gather": (("X", "Index"), ("Out",)),
+    "scatter_add_like": (("Like", "Index", "Updates"), ("Out",)),
+    "scatter": (("Ref", "Index", "Updates"), ("Out",)),
+}.items():
+    set_signature(_name, *_sig)

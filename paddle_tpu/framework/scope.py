@@ -33,7 +33,7 @@ class Scope:
         self._kids: List["Scope"] = []
 
     def new_scope(self) -> "Scope":
-        kid = Scope(parent=self)
+        kid = type(self)(parent=self)  # subclass-preserving (core.Scope)
         self._kids.append(kid)
         return kid
 

@@ -1,0 +1,183 @@
+"""paddle.utils tool scripts (VERDICT r3 missing #3; reference
+python/paddle/utils/{plotcurve,show_pb,dump_config,make_model_diagram,
+image_util,preprocess_img}.py) — every module resolves as
+`python -m paddle.utils.X` and does its job."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG_SRC = """
+from paddle_tpu import dsl
+from paddle_tpu.core.config import OptimizationConf
+
+def get_config():
+    with dsl.model() as g:
+        x = dsl.data("x", 8)
+        y = dsl.data("y", 1, is_ids=True)
+        out = dsl.fc(x, size=3, name="output")
+        dsl.classification_cost(out, y, name="cost")
+    return g.conf, OptimizationConf(learning_method="sgd")
+"""
+
+
+def _run_module(mod, *args, timeout=180):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+           "MPLBACKEND": "Agg"}
+    return subprocess.run(
+        [sys.executable, "-m", mod, *args],
+        capture_output=True, text=True, cwd=REPO, env=env,
+        timeout=timeout,
+    )
+
+
+def test_plotcurve_cli(tmp_path):
+    log = tmp_path / "train.log"
+    log.write_text(
+        "I0101 Pass=0 Batch=10 samples=100 AvgCost=0.9 "
+        "classification_error=0.5\n"
+        "I0101 Pass=0 Batch=20 samples=200 AvgCost=0.7 "
+        "classification_error=0.4\n"
+        "I0101 pass-test samples=50 AvgCost=0.8\n"
+        "I0101 Pass=1 Batch=10 samples=100 AvgCost=0.5 "
+        "classification_error=0.2\n"
+    )
+    out = tmp_path / "curve.png"
+    r = _run_module(
+        "paddle.utils.plotcurve", "-i", str(log), "-o", str(out),
+        "AvgCost", "classification_error",
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert out.exists() and out.stat().st_size > 500
+
+
+def test_plotcurve_api_separates_test_values():
+    from paddle.utils.plotcurve import _extract
+
+    lines = [
+        "Pass=0 AvgCost=1.0\n",
+        "pass-test AvgCost=2.0\n",
+        "Pass=1 AvgCost=0.5\n",
+    ]
+    got = _extract(["AvgCost"], lines)
+    assert got["AvgCost"][0] == [1.0, 0.5]
+    assert got["AvgCost"][1] == [2.0]
+
+
+def test_dump_config_cli(tmp_path):
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(CONFIG_SRC)
+    r = _run_module("paddle.utils.dump_config", str(cfg))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert '"output"' in r.stdout
+
+
+def test_make_model_diagram_cli(tmp_path):
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(CONFIG_SRC)
+    out = tmp_path / "model.dot"
+    r = _run_module(
+        "paddle.utils.make_model_diagram", str(cfg), str(out)
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    dot = out.read_text()
+    assert "digraph" in dot and "output" in dot
+
+
+def test_show_pb_cli(tmp_path):
+    from paddle_tpu.data.proto_provider import write_proto_data
+
+    path = str(tmp_path / "data.bin")
+    write_proto_data(
+        path,
+        [(0, 3), (3, 4)],  # dense vec dim 3 + index
+        [([0.5, 1.0, 1.5], 2), ([2.0, 2.5, 3.0], 1)],
+    )
+    r = _run_module("paddle.utils.show_pb", path)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "DataHeader" in r.stdout
+    assert "VECTOR_DENSE" in r.stdout and "INDEX" in r.stdout
+    assert r.stdout.count("DataSample") == 2
+
+
+def test_image_util_roundtrip(tmp_path):
+    from paddle.utils import image_util as iu
+
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 255, (40, 30, 3), np.uint8)
+    p = str(tmp_path / "img.png")
+    Image.fromarray(arr).save(p)
+
+    img = iu.load_image(p)
+    resized = iu.resize_image(img, 20)
+    assert min(resized.size) == 20
+
+    chw = np.transpose(np.array(resized), (2, 0, 1))
+    crop = iu.crop_img(chw, 16, color=True, test=True)
+    assert crop.shape == (3, 16, 16)
+
+    # oversample: 10 crops (4 corners + center, + mirrors)
+    hwc = np.array(resized).astype(np.float32)
+    crops = iu.oversample([hwc], (16, 16))
+    assert crops.shape == (10, 16, 16, 3)
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1, :])
+
+    t = iu.ImageTransformer(
+        transpose=(2, 0, 1), channel_swap=(2, 1, 0),
+        mean=np.asarray([1.0, 2.0, 3.0]),
+    )
+    out = t.transformer(hwc)
+    assert out.shape == (3, hwc.shape[0], hwc.shape[1])
+    np.testing.assert_allclose(
+        out[0], hwc[:, :, 2] - 1.0, rtol=1e-6
+    )
+
+
+def test_preprocess_img_dataset(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    from paddle.utils.image_util import load_meta
+    from paddle.utils.preprocess_img import (
+        ImageClassificationDatasetCreater,
+    )
+
+    rng = np.random.default_rng(1)
+    for label in ("cat", "dog"):
+        d = tmp_path / label
+        d.mkdir()
+        for i in range(6):
+            Image.fromarray(
+                rng.integers(0, 255, (24, 24, 3), np.uint8)
+            ).save(str(d / f"{i}.png"))
+
+    creater = ImageClassificationDatasetCreater(
+        str(tmp_path), target_size=16, color=True, num_per_batch=4,
+        test_ratio=0.25,
+    )
+    out_dir = creater.create_dataset_from_dir()
+    labels = (tmp_path / "batches" / "labels.txt").read_text()
+    assert "cat" in labels and "dog" in labels
+    train_list = (
+        (tmp_path / "batches" / "train.list").read_text().split()
+    )
+    assert train_list
+    with open(train_list[0], "rb") as f:
+        batch = pickle.load(f)
+    assert batch["data"].shape[1] == 3 * 16 * 16
+    assert len(batch["labels"]) == len(batch["data"])
+
+    # the meta's mean image feeds image_util.load_meta
+    mean = load_meta(
+        os.path.join(out_dir, "batches.meta"), 16, 12, color=True
+    )
+    assert mean.shape == (3, 12, 12)

@@ -495,6 +495,20 @@ class TestBridgeSlots:
             np.asarray(arg.ids), [[1, 2, 3, 4], [5, 6, 7, 0]]
         )
 
+    def test_malformed_subseq_rejected(self):
+        """A subseq refinement missing a sequence boundary must fail
+        loudly, not silently mask real timesteps."""
+        from paddle_tpu import capi_bridge as cb
+
+        ids = np.asarray([1, 2, 3, 4, 5, 6, 7], np.int32)
+        pos = np.asarray([0, 4, 7], np.int32)
+        sub = np.asarray([0, 2, 7], np.int32)  # boundary 4 missing
+        with pytest.raises(ValueError, match="sequence boundary"):
+            cb._slot_to_arg(self._slot(
+                kind=2, buf=self._addr(ids), seq_pos=self._addr(pos),
+                n_seq=3, subseq_pos=self._addr(sub), n_subseq=3,
+            ))
+
     def test_sparse_float_slot(self):
         from paddle_tpu import capi_bridge as cb
 
