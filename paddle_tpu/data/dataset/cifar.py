@@ -12,7 +12,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train10", "test10", "train100", "test100"]
+__all__ = ["convert", "train10", "test10", "train100", "test100"]
 
 CIFAR10_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
 CIFAR100_URL = "https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz"
@@ -79,3 +79,14 @@ def train100():
 def test100():
     return _creator(CIFAR100_URL, "test", b"fine_labels", "test100", 100,
                     128)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference cifar.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    common.convert(path, train100(), 1000, "cifar_train100")
+    common.convert(path, test100(), 1000, "cifar_test100")
+    common.convert(path, train10(), 1000, "cifar_train10")
+    common.convert(path, test10(), 1000, "cifar_test10")

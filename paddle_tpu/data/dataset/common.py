@@ -139,6 +139,7 @@ def convert(output_path: str, reader, line_count: int, name_prefix: str):
     chunked record writer."""
     from paddle_tpu.native.recordio import RecordWriter
 
+    os.makedirs(output_path, exist_ok=True)
     buf, index = [], 0
     paths = []
 

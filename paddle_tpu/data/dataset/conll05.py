@@ -11,7 +11,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["get_dict", "get_embedding", "test"]
+__all__ = ["convert", "get_dict", "get_embedding", "test"]
 
 _WORDS = 150
 _VERBS = 20
@@ -69,3 +69,14 @@ def test():
             )
 
     return reader
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference conll05.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    # like the reference, only the test split is publicly
+    # distributable; it feeds both prefixes
+    common.convert(path, test(), 1000, "conll05_train")
+    common.convert(path, test(), 1000, "conll05_test")

@@ -9,7 +9,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train", "test", "valid"]
+__all__ = ["convert", "train", "test", "valid"]
 
 _CLASSES = 102
 _SHAPE = (3, 32, 32)
@@ -37,3 +37,14 @@ def test(mapper=None, buffered_size=1024, use_xmap=False):
 
 def valid(mapper=None, buffered_size=1024, use_xmap=False):
     return _creator("valid", 102)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (no reference convert for this module; added so every dataset
+    feeds the cloud input path uniformly; common.convert -> go/master
+    RecordIO tasks).
+    """
+    common.convert(path, train(), 200, "flowers_train")
+    common.convert(path, valid(), 200, "flowers_valid")
+    common.convert(path, test(), 200, "flowers_test")

@@ -11,7 +11,7 @@ import tarfile
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["word_dict", "train", "test"]
+__all__ = ["convert", "word_dict", "train", "test"]
 
 URL = (
     "http://ai.stanford.edu/%7Eamaas/data/sentiment/aclImdb_v1.tar.gz"
@@ -111,3 +111,13 @@ def test(word_idx):
         "aclImdb/test/neg/.*\\.txt$",
         word_idx,
     )
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference imdb.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    w = word_dict()
+    common.convert(path, train(w), 1000, "imdb_train")
+    common.convert(path, test(w), 1000, "imdb_test")

@@ -9,7 +9,7 @@ import tarfile
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["build_dict", "train", "test", "DataType"]
+__all__ = ["convert", "build_dict", "train", "test", "DataType"]
 
 URL = "http://www.fit.vutbr.cz/~imikolov/rnnlm/simple-examples.tgz"
 _SYN_VOCAB = 120
@@ -94,3 +94,14 @@ def train(word_idx, n, data_type=DataType.NGRAM):
 
 def test(word_idx, n, data_type=DataType.NGRAM):
     return _creator("test", word_idx, n, data_type)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference imikolov.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    n = 5
+    w = build_dict()
+    common.convert(path, train(w, n), 1000, "imikolov_train")
+    common.convert(path, test(w, n), 1000, "imikolov_test")

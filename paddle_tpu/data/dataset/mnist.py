@@ -12,7 +12,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train", "test"]
+__all__ = ["convert", "train", "test"]
 
 TRAIN_IMAGE_URL = (
     "http://yann.lecun.com/exdb/mnist/train-images-idx3-ubyte.gz"
@@ -69,3 +69,12 @@ def test():
     return _reader_creator(
         TEST_IMAGE_URL, TEST_LABEL_URL, "test", n_synth=256
     )
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference mnist.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    common.convert(path, train(), 1000, "mnist_train")
+    common.convert(path, test(), 1000, "mnist_test")

@@ -10,6 +10,7 @@ from __future__ import annotations
 from paddle_tpu.data.dataset import common
 
 __all__ = [
+    "convert",
     "train",
     "test",
     "movie_categories",
@@ -83,3 +84,12 @@ def train():
 
 def test():
     return _creator("test", 256)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference movielens.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    common.convert(path, train(), 1000, "movielens_train")
+    common.convert(path, test(), 1000, "movielens_test")

@@ -11,7 +11,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train", "test", "FEATURE_DIM"]
+__all__ = ["convert", "train", "test", "FEATURE_DIM"]
 
 URL = (
     "http://research.microsoft.com/en-us/um/beijing/projects/letor/"
@@ -95,3 +95,13 @@ def train(format="pairwise"):
 
 def test(format="pairwise"):
     return _creator("test", format)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (no reference convert for this module; added so every dataset
+    feeds the cloud input path uniformly; common.convert -> go/master
+    RecordIO tasks).
+    """
+    common.convert(path, train(), 1000, "mq2007_train")
+    common.convert(path, test(), 1000, "mq2007_test")

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["get_word_dict", "train", "test"]
+__all__ = ["convert", "get_word_dict", "train", "test"]
 
 _VOCAB = 180
 
@@ -40,3 +40,12 @@ def train():
 
 def test():
     return _creator("test", 50)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference sentiment.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    common.convert(path, train(), 1000, "sentiment_train")
+    common.convert(path, test(), 1000, "sentiment_test")

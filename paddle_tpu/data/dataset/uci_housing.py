@@ -9,7 +9,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train", "test", "feature_range"]
+__all__ = ["convert", "train", "test", "feature_range"]
 
 URL = (
     "https://archive.ics.uci.edu/ml/machine-learning-databases/housing/"
@@ -65,3 +65,12 @@ def test():
             yield d[:-1], d[-1:]
 
     return reader
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference uci_housing.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    common.convert(path, train(), 1000, "uci_housing_train")
+    common.convert(path, test(), 1000, "uci_housing_test")

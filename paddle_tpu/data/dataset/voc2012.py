@@ -9,7 +9,7 @@ import numpy as np
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train", "test", "val"]
+__all__ = ["convert", "train", "test", "val"]
 
 _CLASSES = 21
 _HW = 32
@@ -42,3 +42,14 @@ def test():
 
 def val():
     return _creator("val", 32)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (no reference convert for this module; added so every dataset
+    feeds the cloud input path uniformly; common.convert -> go/master
+    RecordIO tasks).
+    """
+    common.convert(path, train(), 200, "voc2012_train")
+    common.convert(path, val(), 200, "voc2012_val")
+    common.convert(path, test(), 200, "voc2012_test")

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from paddle_tpu.data.dataset import common
 
-__all__ = ["train", "test", "get_dict"]
+__all__ = ["convert", "train", "test", "get_dict"]
 
 START_ID, END_ID, UNK_IDX = 0, 1, 2
 
@@ -44,3 +44,13 @@ def train(dict_size: int):
 
 def test(dict_size: int):
     return _creator("test", dict_size, n=128)
+
+
+def convert(path):
+    """Write the dataset as chunked recordio files for the cloud/
+    elastic-master input path (reference wmt14.py convert;
+    common.convert -> go/master RecordIO tasks).
+    """
+    dict_size = 30000
+    common.convert(path, train(dict_size), 1000, "wmt14_train")
+    common.convert(path, test(dict_size), 1000, "wmt14_test")
