@@ -142,3 +142,24 @@ class TrainStep:
         if self.mesh is not None:
             feed = shard_batch(feed, self.mesh)
         return self._step(params, opt_state, state, feed, step_i, rng)
+
+    def aot(self, params, opt_state, state, feed, step_i, rng):
+        """AOT-compile the step for exactly these args; returns
+        (run, hlo_text) where run() executes the compiled step. The
+        multi-chip gate asserts the expected collectives (all-reduce
+        for dp grads, all-to-all for sp/MoE dispatch,
+        collective-permute for ring/pp) are really in hlo_text, so a
+        sharding-dropping regression fails loudly instead of silently
+        running replicated. AOT compilation does NOT populate the jit
+        dispatch cache — run() reuses the compiled executable so the
+        step is compiled once."""
+        if self.mesh is not None:
+            feed = shard_batch(feed, self.mesh)
+        compiled = self._step.lower(
+            params, opt_state, state, feed, step_i, rng
+        ).compile()
+
+        def run():
+            return compiled(params, opt_state, state, feed, step_i, rng)
+
+        return run, compiled.as_text()

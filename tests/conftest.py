@@ -22,3 +22,25 @@ jax.config.update(
     os.environ.get("PADDLE_TPU_TEST_CACHE", "/tmp/paddle_tpu_jax_cache"),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
+
+
+def start_master(lease="0.6", snapshot=None, extra=()):
+    """Spawn the networked elastic master on a free port; returns
+    (proc, port). Shared by test_master_server.py and the dataset
+    elastic-flow test."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [
+        sys.executable, "-m", "paddle_tpu.data.master_serve",
+        "--port", "0", "--lease-seconds", str(lease), *extra,
+    ]
+    if snapshot:
+        cmd += ["--snapshot", snapshot, "--snapshot-every", "0.2"]
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, text=True, cwd=repo
+    )
+    line = proc.stdout.readline().strip()
+    assert line.startswith("LISTENING"), line
+    return proc, int(line.split()[1])

@@ -268,10 +268,6 @@ class TestConvertWriters:
         elastic reader leases them -> trainer consumes the batches.
         Reference: go/master + cluster_train design docs."""
         import glob
-        import json
-        import subprocess
-        import sys
-        import time
 
         import jax
 
@@ -289,16 +285,12 @@ class TestConvertWriters:
         uci_housing.convert(out)
         files = sorted(glob.glob(out + "/uci_housing_train-*"))
 
+        from conftest import start_master
+
         addr = None
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "paddle_tpu.data.master_serve",
-             "--port", "0", "--lease-seconds", "30"],
-            stdout=subprocess.PIPE, text=True, cwd=repo,
-        )
+        proc, port = start_master(lease="30")
         try:
-            line = proc.stdout.readline().strip()
-            assert line.startswith("LISTENING"), line
-            addr = f"127.0.0.1:{int(line.split()[1])}"
+            addr = f"127.0.0.1:{port}"
             c = MasterClient(addr)
             for path in files:
                 c.add_chunk_tasks(path, count_chunks(path))

@@ -52,18 +52,9 @@ while not c.pass_finished():
 
 
 def _start_master(tmp_path, lease="0.6", snapshot=None, extra=()):
-    cmd = [
-        sys.executable, "-m", "paddle_tpu.data.master_serve",
-        "--port", "0", "--lease-seconds", lease, *extra,
-    ]
-    if snapshot:
-        cmd += ["--snapshot", snapshot, "--snapshot-every", "0.2"]
-    proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, text=True, cwd=REPO
-    )
-    line = proc.stdout.readline().strip()
-    assert line.startswith("LISTENING"), line
-    return proc, int(line.split()[1])
+    from conftest import start_master
+
+    return start_master(lease=lease, snapshot=snapshot, extra=extra)
 
 
 def _start_worker(addr, out_file, hang_at=None):

@@ -1,0 +1,70 @@
+"""The multi-chip gate proves shardings, not just liveness (VERDICT r3
+weak #5): HLO must contain the expected collectives and model-sharded
+params must shrink per device — a sharding-dropping regression flips
+the gate to fail."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_assert_collectives_detects_dropped_sharding():
+    sys.path.insert(0, REPO)
+    try:
+        from __graft_entry__ import _assert_collectives
+    finally:
+        sys.path.pop(0)
+
+    good = "fused... all-reduce ... all-to-all ... collective-permute"
+    _assert_collectives(good, "x", all_reduce=True, all_to_all=True,
+                        collective_permute=True)
+    # a replicated program has none of them
+    with pytest.raises(AssertionError, match="all-reduce"):
+        _assert_collectives("fusion only", "x", all_reduce=True)
+    with pytest.raises(AssertionError, match="collective-permute"):
+        _assert_collectives(
+            "all-reduce", "x", all_reduce=True, collective_permute=True
+        )
+
+
+def test_shard_shrink_detects_replicated_param():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.core.mesh import make_mesh
+
+    sys.path.insert(0, REPO)
+    try:
+        from __graft_entry__ import _assert_shard_shrinks
+    finally:
+        sys.path.pop(0)
+
+    mesh = make_mesh({"model": 2, "data": jax.device_count() // 2})
+    x = np.zeros((8, 4), np.float32)
+    sharded = jax.device_put(
+        x, NamedSharding(mesh, P("model", None))
+    )
+    _assert_shard_shrinks(sharded, 2, "sharded")  # passes
+    replicated = jax.device_put(x, NamedSharding(mesh, P()))
+    with pytest.raises(AssertionError, match="not actually sharded"):
+        _assert_shard_shrinks(replicated, 2, "replicated")
+
+
+def test_dryrun_multichip_8_with_hlo_assertions():
+    """The real gate at 8 virtual devices (subprocess: dryrun sets the
+    global mesh; isolation keeps the suite's conftest mesh clean)."""
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(8); "
+         "print('GATE OK')"],
+        capture_output=True, text=True, cwd=REPO, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "GATE OK" in r.stdout
