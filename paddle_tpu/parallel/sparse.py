@@ -242,7 +242,9 @@ class SparseUpdater:
         return t.reshape(t.shape[0], t.shape[2])[:-1]  # drop scratch
 
     # ---- the kernel ----
-    def _build(self, V, D, k, n_state, dtype):
+    def _make_call(self, V, D, k, n_state, dtype):
+        """The pallas_call updating k touched rows in place (shared by
+        the single-step and amortized multi-step builders)."""
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
@@ -281,7 +283,7 @@ class SparseUpdater:
         # operand index space includes the scalar-prefetch arg: ids=0,
         # gsum=1, tables start at 2; alias table_j -> output_j
         aliases = {2 + j: j for j in range(1 + n_state)}
-        call = pl.pallas_call(
+        return pl.pallas_call(
             kernel,
             grid_spec=grid_spec,
             out_shape=[shape] * (1 + n_state),
@@ -289,7 +291,8 @@ class SparseUpdater:
             interpret=self._interpret,
         )
 
-        def step(param, state, ids, grads):
+    def _one_step(self, call, V, k):
+        def step_once(param, state, ids, grads):
             flat = ids.reshape(-1).astype(jnp.int32)
             uids, gsum = _unique_segment_grads(
                 flat, grads.reshape((flat.shape[0], -1)), k
@@ -298,19 +301,51 @@ class SparseUpdater:
             outs = call(oob, gsum.reshape(k, 1, -1), param, *state)
             return outs[0], tuple(outs[1:])
 
+        return step_once
+
+    def _jit_pinned(self, fn, n_state):
+        """Donating jit with the table layouts pinned on BOTH sides:
+        without out_shardings the compiler would emit outputs in the
+        default (dim0-minor) layout and every subsequent step would pay
+        two full-table relayout copies on entry."""
         if self._interpret:
-            return jax.jit(step, donate_argnums=(0, 1))
-        # pin the table layouts on BOTH sides of the jit: without
-        # out_shardings the compiler would emit outputs in the default
-        # (dim0-minor) layout and every subsequent step would pay two
-        # full-table relayout copies on entry
+            return jax.jit(fn, donate_argnums=(0, 1))
         fmt = self._format()
         return jax.jit(
-            step,
+            fn,
             donate_argnums=(0, 1),
             in_shardings=(fmt, (fmt,) * n_state, None, None),
             out_shardings=(fmt, (fmt,) * n_state),
         )
+
+    def _build(self, V, D, k, n_state, dtype):
+        call = self._make_call(V, D, k, n_state, dtype)
+        return self._jit_pinned(self._one_step(call, V, k), n_state)
+
+    def _build_multi(self, V, D, k, n_state, dtype, n_steps):
+        """n_steps updates inside ONE jitted program (lax.fori_loop over
+        the kernel). Amortizes the per-dispatch floor so benchmarks
+        measure the row-update work itself, and serves k-step update
+        bursts (the catchUpWith batching) with one dispatch."""
+        call = self._make_call(V, D, k, n_state, dtype)
+        step = self._one_step(call, V, k)
+
+        def steps(param, state, ids_seq, grads_seq):
+            def body(i, carry):
+                p, s = carry
+                ids = jax.lax.dynamic_index_in_dim(
+                    ids_seq, i, keepdims=False
+                )
+                g = jax.lax.dynamic_index_in_dim(
+                    grads_seq, i, keepdims=False
+                )
+                return step(p, s, ids, g)
+
+            return jax.lax.fori_loop(
+                0, n_steps, body, (param, tuple(state))
+            )
+
+        return self._jit_pinned(steps, n_state)
 
     def __call__(self, param, ids, grads, state=()):
         V = param.shape[0] - 1  # last row is scratch
@@ -322,4 +357,18 @@ class SparseUpdater:
                 V, D, k, len(state), param.dtype
             )
         return self._steps[key](param, tuple(state), ids, grads)
+
+    def run_steps(self, param, ids_seq, grads_seq, state=()):
+        """Apply n_steps sequential updates in one dispatch.
+        ids_seq: [n_steps, N]; grads_seq: [n_steps, N, D]."""
+        V = param.shape[0] - 1
+        D = param.shape[2]
+        n_steps = ids_seq.shape[0]
+        k = self.num_slots or int(np.prod(ids_seq.shape[1:]))
+        key = ("multi", V, D, k, len(state), str(param.dtype), n_steps)
+        if key not in self._steps:
+            self._steps[key] = self._build_multi(
+                V, D, k, len(state), param.dtype, n_steps
+            )
+        return self._steps[key](param, tuple(state), ids_seq, grads_seq)
 

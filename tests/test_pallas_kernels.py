@@ -258,3 +258,89 @@ class TestGruBwdKernelBlocked:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(bb_), atol=2e-4, err_msg=n
             )
+
+
+class TestFusedBnActConv:
+    """bn_act_conv1x1 (ops/pallas_fused.py) — the fused BN->ReLU->GEMM
+    with stats epilogue + custom VJP (the ResNet-50 1x1 bottleneck
+    lever, PERF.md). Interpret mode on the CPU mesh; parity against the
+    plain-XLA chain it replaces."""
+
+    @staticmethod
+    def _ref(u, sc, sh, w, r=None, relu=True):
+        z = u.astype(jnp.float32) * sc + sh
+        if r is not None:
+            z = z + r.astype(jnp.float32)
+        if relu:
+            z = jnp.maximum(z, 0.0)
+        y = jnp.dot(
+            z.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        return y.astype(u.dtype), jnp.sum(y, 0), jnp.sum(y * y, 0)
+
+    def _inputs(self, n=100, cin=24, cout=16, seed=0):
+        rng = np.random.default_rng(seed)
+        return (
+            jnp.asarray(rng.standard_normal((n, cin)), jnp.float32),
+            jnp.asarray(rng.standard_normal(cin), jnp.float32),
+            jnp.asarray(rng.standard_normal(cin), jnp.float32),
+            jnp.asarray(rng.standard_normal((cin, cout)) * 0.1,
+                        jnp.float32),
+            jnp.asarray(rng.standard_normal((n, cin)), jnp.float32),
+        )
+
+    @pytest.mark.parametrize("act", ["relu", ""])
+    @pytest.mark.parametrize("with_res", [False, True])
+    def test_forward_parity(self, act, with_res):
+        from paddle_tpu.ops.pallas_fused import bn_act_conv1x1
+
+        u, sc, sh, w, r = self._inputs()
+        res = r if with_res else None
+        y, s1, s2 = bn_act_conv1x1(u, sc, sh, w, residual=res, act=act)
+        yr, s1r, s2r = self._ref(u, sc, sh, w, res, act == "relu")
+        np.testing.assert_allclose(y, yr, rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(s1, s1r, rtol=2e-2, atol=2e-1)
+        np.testing.assert_allclose(s2, s2r, rtol=2e-2, atol=5e-1)
+
+    def test_padding_rows_excluded_from_stats(self):
+        # N=100 pads to 104 (bn=8): padded rows must not leak into
+        # stats even with shift>0 (relu(shift) would be nonzero)
+        from paddle_tpu.ops.pallas_fused import bn_act_conv1x1
+
+        u, sc, sh, w, r = self._inputs(n=100)
+        sh = jnp.abs(sh) + 1.0  # make relu(pad-row preact) nonzero
+        y, s1, s2 = bn_act_conv1x1(u, sc, sh, w)
+        yr, s1r, s2r = self._ref(u, sc, sh, w)
+        np.testing.assert_allclose(y, yr, rtol=2e-2, atol=2e-2)
+        np.testing.assert_allclose(s1, s1r, rtol=2e-2, atol=2e-1)
+        # s2 is the leak-sensitive one: squared pad contributions are
+        # strictly positive and cannot cancel
+        np.testing.assert_allclose(s2, s2r, rtol=2e-2, atol=5e-1)
+
+    @pytest.mark.parametrize("with_res", [False, True])
+    def test_grad_parity(self, with_res):
+        from paddle_tpu.ops.pallas_fused import bn_act_conv1x1
+
+        u, sc, sh, w, r = self._inputs()
+        res = r if with_res else None
+
+        def loss_fused(u, sc, sh, w, r):
+            y, s1, s2 = bn_act_conv1x1(u, sc, sh, w, residual=r)
+            return (jnp.sum(y.astype(jnp.float32) * 0.3)
+                    + jnp.sum(s1 * 0.1) + jnp.sum(s2 * 0.01))
+
+        def loss_ref(u, sc, sh, w, r):
+            y, s1, s2 = self._ref(u, sc, sh, w, r)
+            return (jnp.sum(y.astype(jnp.float32) * 0.3)
+                    + jnp.sum(s1 * 0.1) + jnp.sum(s2 * 0.01))
+
+        args = (u, sc, sh, w, res)
+        nargs = (0, 1, 2, 3, 4) if with_res else (0, 1, 2, 3)
+        gf = jax.grad(loss_fused, argnums=nargs)(*args)
+        gr = jax.grad(loss_ref, argnums=nargs)(*args)
+        for name, a, b in zip("u sc sh w r".split(), gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-2,
+                err_msg=name,
+            )

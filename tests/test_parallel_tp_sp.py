@@ -437,3 +437,42 @@ class TestSparseUpdaterKernel:
         assert updated == {5, 10, 15, 20}, updated
         for i in (5, 10, 15, 20):
             np.testing.assert_allclose(out[i], -np.ones(D), atol=1e-6)
+
+
+def test_sparse_updater_run_steps_matches_sequential():
+    """run_steps (n updates fused into one dispatch — the amortized
+    bench/catchUpWith path) must equal n sequential __call__ steps."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.sparse import SparseUpdater
+
+    def upd(p, g, m):
+        m2 = 0.9 * m + g
+        return p - 0.01 * m2, m2
+
+    V, D, N, S = 96, 8, 24, 4
+    rng = np.random.default_rng(7)
+    p0 = rng.standard_normal((V, D)).astype(np.float32)
+    m0 = np.zeros((V, D), np.float32)
+    ids_seq = jnp.asarray(rng.integers(0, V, (S, N)), jnp.int32)
+    grads_seq = jnp.asarray(
+        rng.standard_normal((S, N, D)), jnp.float32
+    )
+
+    a = SparseUpdater(upd)
+    pa, ma = a.place(p0), a.place(m0)
+    for i in range(S):
+        pa, (ma,) = a(pa, ids_seq[i], grads_seq[i], (ma,))
+
+    b = SparseUpdater(upd)
+    pb, mb = b.place(p0), b.place(m0)
+    pb, (mb,) = b.run_steps(pb, ids_seq, grads_seq, (mb,))
+
+    np.testing.assert_allclose(
+        SparseUpdater.unplace(pb), SparseUpdater.unplace(pa),
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        SparseUpdater.unplace(mb), SparseUpdater.unplace(ma),
+        rtol=1e-5, atol=1e-6,
+    )
