@@ -249,6 +249,22 @@ def conv(x, num_filters, filter_size, stride=1, padding=0, groups=1,
                 dilation=dilation, **kw)
 
 
+def fused_conv1x1_bn(x, num_filters, act="relu", name=None):
+    """1x1 conv + batch norm with epilogue stats (layers/fused.py —
+    the ResNet bottleneck MFU lever)."""
+    return _add("fused_conv1x1_bn", [x], name=name, size=num_filters,
+                act=act, bias=False)
+
+
+def fused_bottleneck_tail(x, num_filters, residual=None, act="relu",
+                          name=None):
+    """BN+ReLU -> 1x1 conv -> BN [+ residual] -> act as one fused layer
+    (layers/fused.py)."""
+    ins = [x] if residual is None else [x, residual]
+    return _add("fused_bottleneck_tail", ins, name=name,
+                size=num_filters, act=act, bias=False)
+
+
 def conv_trans(x, num_filters, filter_size, stride=1, padding=0, name=None,
                act="relu", bias=True, param=None, bias_param=None):
     return _add("exconvt", [x], name=name, size=num_filters, act=act,

@@ -8,6 +8,7 @@ from paddle_tpu.layers import (  # noqa: F401
     cost,
     detection,
     extras,
+    fused,
     moe,
     norm,
     pool,

@@ -1,0 +1,206 @@
+"""Fused bottleneck layers over the Mosaic BN->ReLU->1x1-GEMM kernel.
+
+The graph-level face of ops/pallas_fused.py (the ResNet-50 MFU lever,
+PERF.md; CUDA analogue: the reference's hand-fused kernels in
+cuda/src/hl_cuda_cnn.cu). Two layer types replace the XLA-separate
+chains of the bottleneck block (models/image.py _bottleneck):
+
+- `fused_conv1x1_bn`   = conv(1x1, no bias) + batch_norm(act):
+  the GEMM runs with a stats epilogue, so the BN statistics cost no
+  extra passes over the conv output; the normalize+act stays XLA
+  elementwise (its output is consumed by the next conv anyway).
+- `fused_bottleneck_tail` = batch_norm(act=relu) + conv(1x1, no bias)
+  + batch_norm + residual add + act: the first BN's normalize/ReLU is
+  folded into the GEMM's input side (the normalized activation is
+  never materialized), the second BN's stats come from the epilogue,
+  and the final normalize+add+act is one XLA elementwise pass.
+
+Both match the plain graph numerically (tests/test_layers_extras.py
+TestFusedBottleneck) and run in interpret mode on CPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.core.config import ParameterConf
+from paddle_tpu.core.registry import LAYERS
+from paddle_tpu.layers.base import Layer, Spec
+
+
+def _bn_affine(gamma, beta, mean, var, eps):
+    """BN normalize folded to per-channel (scale, shift), f32."""
+    f32 = jnp.float32
+    inv = lax.rsqrt(var.astype(f32) + eps)
+    scale = gamma.astype(f32) * inv
+    shift = beta.astype(f32) - mean.astype(f32) * scale
+    return scale, shift
+
+
+def _moments_from_epilogue(s1, s2, n):
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
+    return mean, var
+
+
+def _bn_param_confs(layer, c, prefix):
+    gamma = ParameterConf(
+        name=f"_{layer.name}.{prefix}g", dims=(c,),
+        initial_strategy="constant", initial_value=1.0,
+    )
+    beta = ParameterConf(
+        name=f"_{layer.name}.{prefix}b", dims=(c,),
+        initial_strategy="constant", initial_value=0.0,
+    )
+    return gamma, beta
+
+
+@LAYERS.register("fused_conv1x1_bn")
+class FusedConv1x1BN(Layer):
+    """1x1 conv (stride 1, no bias) + BatchNorm(act) with the BN stats
+    accumulated in the GEMM's epilogue. attrs: num_filters, epsilon,
+    moving_average_fraction, use_global_stats."""
+
+    def build(self, in_specs):
+        (s,) = in_specs
+        h, w, c = s.dim
+        nf = self.conf.attrs.get("num_filters", self.conf.size)
+        pcs = {"w0": self.weight_conf(0, (c, nf))}
+        if pcs["w0"].initial_std is None:
+            pcs["w0"].initial_std = (2.0 / c) ** 0.5
+        pcs["g"], pcs["b"] = _bn_param_confs(self, nf, "bn")
+        self._channels = nf
+        self._in_shape = (h, w, c)
+        return Spec(dim=(h, w, nf), is_seq=s.is_seq), pcs
+
+    def init_state(self):
+        c = self._channels
+        return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+    def forward(self, params, inputs, ctx):
+        from paddle_tpu.ops.pallas_fused import bn_act_conv1x1
+
+        (arg,) = inputs
+        a = self.conf.attrs
+        eps = a.get("epsilon", 1e-5)
+        frac = a.get("moving_average_fraction", 0.9)
+        use_global = a.get("use_global_stats", False) or not ctx.train
+        x = arg.value
+        b, h, w, c = x.shape
+        n = b * h * w
+        cin = self._in_shape[2]
+        ones = jnp.ones((cin,), jnp.float32)
+        zeros = jnp.zeros((cin,), jnp.float32)
+        y2d, s1, s2 = bn_act_conv1x1(
+            x.reshape(n, cin), ones, zeros, params["w0"], act=""
+        )
+        st = ctx.state[self.name]
+        if use_global:
+            mean, var = st["mean"], st["var"]
+            ctx.updated_state[self.name] = st
+        else:
+            mean, var = _moments_from_epilogue(s1, s2, n)
+            ctx.updated_state[self.name] = {
+                "mean": st["mean"] * frac + mean * (1 - frac),
+                "var": st["var"] * frac + var * (1 - frac),
+            }
+        scale, shift = _bn_affine(
+            params["g"], params["b"], mean, var, eps
+        )
+        y = y2d.reshape(b, h, w, -1)
+        y = y * scale.astype(y.dtype) + shift.astype(y.dtype)
+        y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
+        return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("fused_bottleneck_tail")
+class FusedBottleneckTail(Layer):
+    """BN(in)+ReLU -> 1x1 conv -> BN(out) [+ residual] -> act, with the
+    in-BN normalize/ReLU fused into the GEMM input side and the out-BN
+    stats from the epilogue. Inputs: [conv_raw, residual?]. attrs:
+    num_filters, epsilon, moving_average_fraction, use_global_stats."""
+
+    def build(self, in_specs):
+        s = in_specs[0]
+        h, w, c = s.dim
+        nf = self.conf.attrs.get("num_filters", self.conf.size)
+        if len(in_specs) > 1:
+            rs = in_specs[1]
+            assert rs.dim == (h, w, nf), (
+                f"{self.name}: residual dim {rs.dim} != output "
+                f"{(h, w, nf)}"
+            )
+        pcs = {"w0": self.weight_conf(0, (c, nf))}
+        if pcs["w0"].initial_std is None:
+            pcs["w0"].initial_std = (2.0 / c) ** 0.5
+        pcs["gi"], pcs["bi"] = _bn_param_confs(self, c, "bni")
+        pcs["go"], pcs["bo"] = _bn_param_confs(self, nf, "bno")
+        self._cin, self._cout = c, nf
+        return Spec(dim=(h, w, nf), is_seq=s.is_seq), pcs
+
+    def init_state(self):
+        return {
+            "in_mean": jnp.zeros((self._cin,)),
+            "in_var": jnp.ones((self._cin,)),
+            "out_mean": jnp.zeros((self._cout,)),
+            "out_var": jnp.ones((self._cout,)),
+        }
+
+    def forward(self, params, inputs, ctx):
+        from paddle_tpu.ops.pallas_fused import bn_act_conv1x1
+
+        arg = inputs[0]
+        res = inputs[1].value if len(inputs) > 1 else None
+        a = self.conf.attrs
+        eps = a.get("epsilon", 1e-5)
+        frac = a.get("moving_average_fraction", 0.9)
+        use_global = a.get("use_global_stats", False) or not ctx.train
+        x = arg.value
+        b, h, w, c = x.shape
+        n = b * h * w
+        st = ctx.state[self.name]
+        f32 = jnp.float32
+
+        # in-BN statistics over the raw conv output (one bf16 pass —
+        # same formulation as layers/norm.py BatchNormLayer)
+        if use_global:
+            in_mean, in_var = st["in_mean"], st["in_var"]
+        else:
+            red = (0, 1, 2)
+            in_mean = jnp.mean(x, axis=red, dtype=f32)
+            if x.dtype == f32:
+                in_var = jnp.mean(
+                    jnp.square(x - in_mean), axis=red, dtype=f32
+                )
+            else:
+                msq = jnp.mean(jnp.square(x), axis=red, dtype=f32)
+                in_var = jnp.maximum(msq - jnp.square(in_mean), 0.0)
+        scale_i, shift_i = _bn_affine(
+            params["gi"], params["bi"], in_mean, in_var, eps
+        )
+
+        y2d, s1, s2 = bn_act_conv1x1(
+            x.reshape(n, c), scale_i, shift_i, params["w0"], act="relu"
+        )
+        if use_global:
+            out_mean, out_var = st["out_mean"], st["out_var"]
+            ctx.updated_state[self.name] = st
+        else:
+            out_mean, out_var = _moments_from_epilogue(s1, s2, n)
+            ctx.updated_state[self.name] = {
+                "in_mean": st["in_mean"] * frac + in_mean * (1 - frac),
+                "in_var": st["in_var"] * frac + in_var * (1 - frac),
+                "out_mean": st["out_mean"] * frac + out_mean * (1 - frac),
+                "out_var": st["out_var"] * frac + out_var * (1 - frac),
+            }
+        scale_o, shift_o = _bn_affine(
+            params["go"], params["bo"], out_mean, out_var, eps
+        )
+        y = y2d.reshape(b, h, w, -1)
+        y = y * scale_o.astype(y.dtype) + shift_o.astype(y.dtype)
+        if res is not None:
+            y = y + res
+        y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
+        return Arg(value=y, seq_lens=arg.seq_lens)

@@ -81,7 +81,7 @@ def _fwd_kernel(n_valid, relu, has_res):
         rows = lax.broadcasted_iota(jnp.int32, (bn, 1), 0) + i * bn
         z = jnp.where(rows < n_valid, z, 0.0)
         y = jnp.dot(
-            z.astype(jnp.bfloat16), w_ref[...],
+            z.astype(w_ref.dtype), w_ref[...],
             preferred_element_type=jnp.float32,
         )
         y_ref[...] = y.astype(y_ref.dtype)
@@ -135,7 +135,8 @@ def _fused_fwd_impl(u, scale, shift, w, res, relu):
         u_p,
         scale.reshape(1, cin).astype(jnp.float32),
         shift.reshape(1, cin).astype(jnp.float32),
-        w.astype(jnp.bfloat16),
+        # MXU in bf16 under AMP; full precision otherwise (tests)
+        w.astype(u.dtype),
     ]
     if res is not None:
         args.append(_pad_rows(res, bn)[0])
@@ -168,7 +169,7 @@ def _bwd_dx_kernel(n_valid, relu, has_res):
         # dz = dy_eff @ w^T — contract over cout without materializing
         # the transpose
         dz = lax.dot_general(
-            dy_eff.astype(jnp.bfloat16), w_ref[...],
+            dy_eff.astype(w_ref.dtype), w_ref[...],
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -217,8 +218,9 @@ def _bwd_dw_kernel(n_valid, relu, has_res):
         y = y_ref[...].astype(jnp.float32)
         dy_eff = dy_ref[...].astype(jnp.float32) + d1_ref[...] \
             + 2.0 * y * d2_ref[...]
+        mxu = u_ref.dtype
         dw = lax.dot_general(
-            z.astype(jnp.bfloat16), dy_eff.astype(jnp.bfloat16),
+            z.astype(mxu), dy_eff.astype(mxu),
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -255,7 +257,7 @@ def _bwd_impl(relu, has_res, residuals, cotangents):
     wspec = pl.BlockSpec((cin, cout), lambda i: (0, 0))
 
     in_specs = [urow, cvec, cvec, wspec]
-    args = [u_p, s2d, t2d, w.astype(jnp.bfloat16)]
+    args = [u_p, s2d, t2d, w.astype(u.dtype)]
     if has_res:
         in_specs.append(urow)
         args.append(_pad_rows(res, bn)[0])

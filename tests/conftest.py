@@ -17,10 +17,10 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent XLA compilation cache: the suite is compile-dominated on a
 # single-core gate machine, and repeated runs (judge re-runs, local
 # iteration) hit the cache and finish several times faster.
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("PADDLE_TPU_TEST_CACHE", "/tmp/paddle_tpu_jax_cache"),
+XLA_CACHE_DIR = os.environ.get(
+    "PADDLE_TPU_TEST_CACHE", "/tmp/paddle_tpu_jax_cache"
 )
+jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 

@@ -64,7 +64,12 @@ def test_dryrun_multichip_8_with_hlo_assertions():
          "print('GATE OK')"],
         capture_output=True, text=True, cwd=REPO, timeout=420,
         env={**os.environ, "JAX_PLATFORMS": "cpu",
-             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             # the subprocess has no conftest: point it at the same
+             # persistent XLA cache so warm suite runs stay fast
+             "JAX_COMPILATION_CACHE_DIR": __import__(
+                 "conftest"
+             ).XLA_CACHE_DIR},
     )
     assert r.returncode == 0, r.stderr[-3000:]
     assert "GATE OK" in r.stdout

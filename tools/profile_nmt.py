@@ -1,0 +1,138 @@
+"""NMT (seq2seq+attention) train-step profile: timings, XLA cost
+analysis, analytic-FLOP MFU, and an XPlane trace — the ResNet-style
+accounting for the second north star (VERDICT r3 weak #2; reference
+benchmark/paddle/rnn/rnn.py + benchmark/README.md:139).
+
+Usage: python tools/profile_nmt.py [--bs 256] [--t 32]
+       [--trace-dir /tmp/nmt-trace]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bs", type=int, default=256)
+    ap.add_argument("--t", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=512)
+    ap.add_argument("--emb", type=int, default=512)
+    ap.add_argument("--vocab", type=int, default=30000)
+    ap.add_argument("--trace-dir", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    from paddle_tpu.core import flags as _flags
+
+    _flags.set_flag("matmul_precision", "bfloat16")
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+    from paddle_tpu.core.arg import id_arg
+    from paddle_tpu.models import seq2seq_attention
+    from paddle_tpu.network import Network
+
+    bs, t = args.bs, args.t
+    conf = seq2seq_attention(
+        src_vocab=args.vocab, trg_vocab=args.vocab,
+        emb_dim=args.emb, hidden=args.hidden,
+    )
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    state = net.init_state()
+    rng = np.random.default_rng(0)
+    lens = np.full((bs,), t, np.int32)
+    feed = jax.device_put({
+        "src": id_arg(
+            rng.integers(2, args.vocab, (bs, t)).astype(np.int32), lens
+        ),
+        "trg_in": id_arg(
+            rng.integers(2, args.vocab, (bs, t)).astype(np.int32), lens
+        ),
+        "trg_out": id_arg(
+            rng.integers(2, args.vocab, (bs, t)).astype(np.int32), lens
+        ),
+    })
+    key = jax.random.key(1)
+
+    def loss(p, f):
+        return net.loss_fn(p, f, state=state, rng=key, train=True)[0]
+
+    gf = jax.jit(lambda p, f: jax.grad(loss)(p, f))
+    c = gf.lower(params, feed).compile()
+    ca = c.cost_analysis()
+    ca = ca[0] if isinstance(ca, list) else ca
+    ma = c.memory_analysis()
+
+    def bench(f, *a, n=10):
+        for _ in range(5):
+            r = f(*a)
+        float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                r = f(*a)
+            float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best * 1e3
+
+    ms = bench(gf, params, feed)
+
+    # same conventions as bench.py (import the single source of truth)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"),
+    )
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+    analytic = bench_mod._nmt_train_flops_per_batch(
+        bs, t, args.hidden, args.vocab, args.emb
+    )
+    peak = bench_mod.TPU_PEAK_FLOPS
+    xla_flops = ca.get("flops", 0)
+    xla_bytes = ca.get("bytes accessed", 0)
+    report = {
+        "batch_size": bs,
+        "seq_len": t,
+        "fwd_bwd_ms": round(ms, 2),
+        "tokens_per_s": round(bs * t / ms * 1e3, 0),
+        "analytic_flops_per_batch": analytic,
+        "xla_flops": xla_flops,
+        "xla_bytes_accessed": xla_bytes,
+        "hbm_temp_bytes": ma.temp_size_in_bytes,
+        "mfu_analytic": round(analytic / (ms / 1e3) / peak, 4),
+        "mfu_xla": round(xla_flops / (ms / 1e3) / peak, 4),
+        # arithmetic intensity vs the v5e ridge (~240 FLOP/byte):
+        # below it the step is HBM-bound and the MFU ceiling is
+        # intensity/ridge
+        "flop_per_byte_xla": round(xla_flops / max(xla_bytes, 1), 1),
+    }
+    print(json.dumps(report, indent=2))
+
+    if args.trace_dir:
+        from paddle_tpu.core import profiler
+
+        with profiler.trace(args.trace_dir):
+            for _ in range(3):
+                r = gf(params, feed)
+            float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+        print(f"trace written to {args.trace_dir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--bs", type=int, default=256)
     ap.add_argument("--trace-dir", default="")
+    ap.add_argument("--fused", action="store_true",
+                    help="profile the fused-bottleneck graph "
+                         "(layers/fused.py Mosaic kernels)")
     args = ap.parse_args()
 
     import jax
@@ -36,7 +39,8 @@ def main():
     from paddle_tpu.network import Network
 
     bs = args.bs
-    conf = resnet(depth=50, image_shape=(224, 224, 3), num_classes=1000)
+    conf = resnet(depth=50, image_shape=(224, 224, 3),
+                  num_classes=1000, fused=args.fused)
     net = Network(conf)
     params = net.init_params(jax.random.key(0))
     state = net.init_state()
@@ -73,6 +77,7 @@ def main():
 
     ms = bench(gf, params, feed)
     report = {
+        "graph": "fused" if args.fused else "plain",
         "batch_size": bs,
         "fwd_bwd_ms": round(ms, 2),
         "xla_flops": ca.get("flops", 0),
