@@ -672,18 +672,29 @@ def bench_beam_decode(bs=32, t_src=32, beam=4, max_len=32, hidden=512,
         return best
 
     t_off = run_decoder(None)
-    t_on = run_decoder(BeamHooks(adjust=lambda logp, t: logp))
     tok_s = bs * max_len / t_off
-    return {
+    out = {
         "value": round(tok_s, 0),
         "unit": "decode tokens/s (best beam, hooks off)",
         "beam": beam,
         "max_len": max_len,
         "batch_size": bs,
         "all_beams_tok_s": round(bs * beam * max_len / t_off, 0),
-        "hooks_on_tok_s": round(bs * max_len / t_on, 0),
-        "hooks_overhead_x": round(t_on / t_off, 2),
     }
+    try:
+        t_on = run_decoder(BeamHooks(adjust=lambda logp, t: logp))
+        out["hooks_on_tok_s"] = round(bs * max_len / t_on, 0)
+        out["hooks_overhead_x"] = round(t_on / t_off, 2)
+    except Exception as e:
+        # the axon tunnel runtime does not support host callbacks
+        # (pure_callback raises UNIMPLEMENTED); any OTHER failure is a
+        # real hook regression and must surface as an error line.
+        # Hook correctness is covered by test_beam_search.TestHostHooks.
+        msg = str(e)
+        if "UNIMPLEMENTED" not in msg:
+            raise  # a real hook regression, not a runtime limitation
+        out["hooks_on"] = f"unavailable: {msg}"[:120]
+    return out
 
 
 def build_sweep():

@@ -249,20 +249,28 @@ def conv(x, num_filters, filter_size, stride=1, padding=0, groups=1,
                 dilation=dilation, **kw)
 
 
-def fused_conv1x1_bn(x, num_filters, act="relu", name=None):
+def fused_conv1x1_bn(x, num_filters, act="relu", name=None,
+                     use_global_stats=False,
+                     moving_average_fraction=0.9, epsilon=1e-5):
     """1x1 conv + batch norm with epilogue stats (layers/fused.py —
-    the ResNet bottleneck MFU lever)."""
+    the ResNet bottleneck MFU lever). BN kwargs mirror batch_norm."""
     return _add("fused_conv1x1_bn", [x], name=name, size=num_filters,
-                act=act, bias=False)
+                act=act, bias=False, use_global_stats=use_global_stats,
+                moving_average_fraction=moving_average_fraction,
+                epsilon=epsilon)
 
 
 def fused_bottleneck_tail(x, num_filters, residual=None, act="relu",
-                          name=None):
+                          name=None, use_global_stats=False,
+                          moving_average_fraction=0.9, epsilon=1e-5):
     """BN+ReLU -> 1x1 conv -> BN [+ residual] -> act as one fused layer
-    (layers/fused.py)."""
+    (layers/fused.py). BN kwargs mirror batch_norm."""
     ins = [x] if residual is None else [x, residual]
     return _add("fused_bottleneck_tail", ins, name=name,
-                size=num_filters, act=act, bias=False)
+                size=num_filters, act=act, bias=False,
+                use_global_stats=use_global_stats,
+                moving_average_fraction=moving_average_fraction,
+                epsilon=epsilon)
 
 
 def conv_trans(x, num_filters, filter_size, stride=1, padding=0, name=None,

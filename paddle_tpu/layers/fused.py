@@ -65,6 +65,11 @@ class FusedConv1x1BN(Layer):
 
     def build(self, in_specs):
         (s,) = in_specs
+        assert not s.is_seq, (
+            f"{self.name}: fused BN layers compute unmasked batch "
+            "statistics — sequence inputs would let padding corrupt "
+            "them (use conv+batch_norm)"
+        )
         h, w, c = s.dim
         nf = self.conf.attrs.get("num_filters", self.conf.size)
         pcs = {"w0": self.weight_conf(0, (c, nf))}
@@ -124,6 +129,11 @@ class FusedBottleneckTail(Layer):
 
     def build(self, in_specs):
         s = in_specs[0]
+        assert not s.is_seq, (
+            f"{self.name}: fused BN layers compute unmasked batch "
+            "statistics — sequence inputs would let padding corrupt "
+            "them (use conv+batch_norm)"
+        )
         h, w, c = s.dim
         nf = self.conf.attrs.get("num_filters", self.conf.size)
         if len(in_specs) > 1:

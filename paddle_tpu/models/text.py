@@ -133,6 +133,26 @@ def _attention_decoder_step(hidden, trg_vocab, emb_dim):
     return step
 
 
+def _attention_decoder_state_step(hidden, trg_vocab, emb_dim):
+    """Training-time step: returns the decoder STATE only; the h->V
+    softmax projection is applied OUTSIDE the scan as one batched GEMM
+    (see seq2seq_attention). Same parameters, same math."""
+    from paddle_tpu import dsl
+    from paddle_tpu.core.config import ParameterConf
+
+    def step(trg_word, enc):
+        emb = dsl.embedding(trg_word, size=emb_dim, vocab_size=trg_vocab,
+                            param=ParameterConf(name="trg_emb"),
+                            name="trg_emb_lookup")
+        prev = dsl.memory("dec_state", size=hidden)
+        ctx_vec = dsl.simple_attention(enc, enc, prev, name="att",
+                                       size=hidden)
+        return dsl.fc(emb, prev, ctx_vec, size=hidden, act="tanh",
+                      name="dec_state")
+
+    return step
+
+
 def seq2seq_attention(
     src_vocab=30000,
     trg_vocab=30000,
@@ -146,7 +166,14 @@ def seq2seq_attention(
     from paddle_tpu import dsl
     from paddle_tpu.core.config import InputConf, ParameterConf
 
-    step = _attention_decoder_step(hidden, trg_vocab, emb_dim)
+    # the projection is hoisted OUT of the decoder scan: the step emits
+    # the decoder state, and one batched [B*T, h] @ [h, V] GEMM applies
+    # dec_prob afterwards — identical math and parameter names (the
+    # generation decoder still projects in-step), but the 30 MB
+    # projection weight is read once per batch instead of once per
+    # timestep, and the GEMM is T× larger for the MXU (measured: the
+    # in-scan form ran the whole step at 16.5% analytic MFU)
+    step = _attention_decoder_state_step(hidden, trg_vocab, emb_dim)
     with dsl.model() as g:
         src = dsl.data("src", (1,), is_seq=True, is_ids=True)
         trg_in = dsl.data("trg_in", (1,), is_seq=True, is_ids=True)
@@ -162,11 +189,13 @@ def seq2seq_attention(
         # (its scan runs right-to-left and is re-reversed to time order)
         enc_summary = dsl.first_seq(bwd, name="enc_summary")
         boot = dsl.fc(enc_summary, size=hidden, act="tanh", name="dec_boot")
-        prob = dsl.recurrent_group(
+        states = dsl.recurrent_group(
             step, [trg_in, dsl.StaticInput(enc)], name="decoder"
         )
+        prob = dsl.fc(states, size=trg_vocab, act="softmax",
+                      name="dec_prob")
         dsl.cross_entropy(prob, trg_out, name="cost")
-        g.conf.output_layer_names.append("decoder")
+        g.conf.output_layer_names.append("dec_prob")
     # wire the decoder-state boot to the parent layer
     rg = g.conf.layer("decoder")
     for m in rg.attrs["memories"]:

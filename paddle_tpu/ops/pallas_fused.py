@@ -39,17 +39,21 @@ def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
-def _block_rows(n: int, cin: int, cout: int) -> int:
+def _block_rows(n: int, cin: int, cout: int, itemsize: int = 2) -> int:
     """Row-block size. Two failure modes bound it: too small and the
     grid's per-step fixed cost dominates (measured: bn=512 at
     N=802816/Cin=64 was grid-overhead-bound); too big and the kernel
     blows the 16 MiB scoped-VMEM stack (double-buffered in/out DMA
-    blocks plus f32 compute temporaries — the bwd kernel holds
-    ~12*Cin + 16*Cout bytes per row)."""
-    budget = 8 << 20
-    per_row = 12 * cin + 16 * cout
+    blocks plus f32 compute temporaries). `itemsize` is the activation/
+    weight dtype width — f32 inputs (non-AMP) double both the resident
+    weight block and the row DMA buffers, so the budget shrinks."""
+    # resident weight block (double-buffered) comes off the top
+    budget = (8 << 20) - 2 * cin * cout * itemsize
+    # per-row: in/out DMA blocks (u, du, y, dy at itemsize, x2 double
+    # buffering) + f32 temporaries (z/dz/dy_eff)
+    per_row = (4 * cin + 4 * cout) * itemsize + 8 * cin + 8 * cout
     for bn in (4096, 2048, 1024, 512, 256, 128, 64, 32, 8):
-        if bn * per_row > budget or bn > max(n, 8):
+        if budget <= 0 or bn * per_row > budget or bn > max(n, 8):
             continue
         return bn
     return 8
@@ -129,7 +133,7 @@ def _fwd_call(n, n_pad, bn, cin, cout, dtype, relu, has_res, interpret):
 def _fused_fwd_impl(u, scale, shift, w, res, relu):
     n, cin = u.shape
     cout = w.shape[1]
-    bn = _block_rows(n, cin, cout)
+    bn = _block_rows(n, cin, cout, u.dtype.itemsize)
     u_p, n_pad = _pad_rows(u, bn)
     args = [
         u_p,
@@ -239,7 +243,7 @@ def _bwd_impl(relu, has_res, residuals, cotangents):
     dy, d1, d2 = cotangents
     n, cin = u.shape
     cout = w.shape[1]
-    bn = _block_rows(n, cin, cout)
+    bn = _block_rows(n, cin, cout, u.dtype.itemsize)
     u_p, n_pad = _pad_rows(u, bn)
     y_p, _ = _pad_rows(y, bn)
     dy_p, _ = _pad_rows(dy, bn)

@@ -21,6 +21,9 @@ XLA_CACHE_DIR = os.environ.get(
     "PADDLE_TPU_TEST_CACHE", "/tmp/paddle_tpu_jax_cache"
 )
 jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
+# subprocess-spawning tests inherit the same cache through the
+# environment — one source of truth for the path
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", XLA_CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 

@@ -63,13 +63,11 @@ def test_dryrun_multichip_8_with_hlo_assertions():
          "import __graft_entry__ as g; g.dryrun_multichip(8); "
          "print('GATE OK')"],
         capture_output=True, text=True, cwd=REPO, timeout=420,
+        # JAX_COMPILATION_CACHE_DIR is inherited from os.environ
+        # (conftest.py exports it), so the subprocess shares the
+        # suite's persistent XLA cache
         env={**os.environ, "JAX_PLATFORMS": "cpu",
-             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-             # the subprocess has no conftest: point it at the same
-             # persistent XLA cache so warm suite runs stay fast
-             "JAX_COMPILATION_CACHE_DIR": __import__(
-                 "conftest"
-             ).XLA_CACHE_DIR},
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8"},
     )
     assert r.returncode == 0, r.stderr[-3000:]
     assert "GATE OK" in r.stdout

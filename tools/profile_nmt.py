@@ -88,18 +88,10 @@ def main():
 
     ms = bench(gf, params, feed)
 
-    # same conventions as bench.py (import the single source of truth)
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    import importlib.util
+    # same conventions as bench.py (the single source of truth; the
+    # repo root is already on sys.path from the top of this file)
+    import bench as bench_mod
 
-    spec = importlib.util.spec_from_file_location(
-        "bench",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "bench.py"),
-    )
-    bench_mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(bench_mod)
     analytic = bench_mod._nmt_train_flops_per_batch(
         bs, t, args.hidden, args.vocab, args.emb
     )
