@@ -48,4 +48,17 @@ class Operator:
 
     @staticmethod
     def backward(forward_op, no_grad_set=frozenset()):
-        return _build_backward(forward_op, set(no_grad_set))
+        from paddle_tpu.framework.op import EMPTY_VAR
+
+        # reference pybind semantics: the CALLER seeds the forward
+        # outputs' gradients in the scope before running the net, so
+        # they must not be zero-filled by the builder
+        seeded = {
+            n
+            for ns in forward_op.outputs.values()
+            for n in ns
+            if n != EMPTY_VAR
+        }
+        return _build_backward(
+            forward_op, set(no_grad_set), seeded=seeded
+        )

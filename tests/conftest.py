@@ -22,8 +22,9 @@ XLA_CACHE_DIR = os.environ.get(
 )
 jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
 # subprocess-spawning tests inherit the same cache through the
-# environment — one source of truth for the path
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", XLA_CACHE_DIR)
+# environment — plain assignment so it really is one source of truth
+# even when the outer environment already set a different cache dir
+os.environ["JAX_COMPILATION_CACHE_DIR"] = XLA_CACHE_DIR
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.3)
 
 

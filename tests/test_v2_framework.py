@@ -176,5 +176,181 @@ class TestOperatorFactory(unittest.TestCase):
         self.assertIs(M, OpTestMeta)
 
 
+
+class TestMeanOp(unittest.TestCase, metaclass=OpTestMeta):
+    # reference tests/test_mean_op.py
+    type = "mean"
+
+    def setUp(self):
+        x = np.random.default_rng(10).random((10, 10)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.mean(x)}
+
+
+class TestMulOp(unittest.TestCase, metaclass=OpTestMeta):
+    # reference tests/test_mul_op.py
+    type = "mul"
+
+    def setUp(self):
+        rng = np.random.default_rng(11)
+        a = rng.random((32, 84)).astype(np.float32)
+        b = rng.random((84, 100)).astype(np.float32)
+        self.inputs = {"X": a, "Y": b}
+        self.outputs = {"Out": a @ b}
+
+
+class TestSigmoidOp(unittest.TestCase, metaclass=OpTestMeta):
+    # reference tests/test_sigmoid_op.py
+    type = "sigmoid"
+
+    def setUp(self):
+        x = np.random.default_rng(12).random((15, 31)).astype(np.float32)
+        self.inputs = {"X": x}
+        self.outputs = {"Y": 1.0 / (1.0 + np.exp(-x))}
+
+
+class TestFillZerosLikeOp(unittest.TestCase, metaclass=OpTestMeta):
+    # reference tests/test_fill_zeros_like_op.py
+    type = "fill_zeros_like"
+
+    def setUp(self):
+        x = np.random.default_rng(13).random((219, 232)).astype(
+            np.float32
+        )
+        self.inputs = {"Src": x}
+        self.outputs = {"Dst": np.zeros_like(x)}
+
+
+class TestCrossEntropyOp(unittest.TestCase, metaclass=OpTestMeta):
+    # reference tests/test_cross_entropy_op.py (onehot_cross_entropy)
+    type = "onehot_cross_entropy"
+
+    def setUp(self):
+        rng = np.random.default_rng(14)
+        bs, classes = 32, 10
+        x = rng.uniform(0.1, 1.0, (bs, classes)).astype(np.float32)
+        labels = rng.integers(0, classes, bs).astype(np.int32)
+        self.inputs = {"X": x, "label": labels}
+        self.outputs = {
+            "Y": -np.log(x[np.arange(bs), labels]).astype(np.float32)
+        }
+
+
+class TestRandomOps(unittest.TestCase):
+    # reference tests/test_gaussian_random_op.py + uniform_random
+    def test_gaussian_random(self):
+        from paddle.v2.framework.core import Scope
+
+        scope = Scope()
+        op = Operator(
+            "gaussian_random", Out="X", dims=[1000, 784], mean=0.0,
+            std=1.0, seed=10,
+        )
+        op.run(scope)
+        tensor = np.asarray(scope.get("X"))
+        self.assertEqual(tensor.shape, (1000, 784))
+        self.assertAlmostEqual(float(tensor.mean()), 0.0, delta=0.1)
+        self.assertAlmostEqual(float(tensor.std()), 1.0, delta=0.1)
+
+    def test_uniform_random(self):
+        from paddle.v2.framework.core import Scope
+
+        scope = Scope()
+        op = Operator(
+            "uniform_random", Out="X", dims=[1000, 784], min=-5.0,
+            max=10.0, seed=10,
+        )
+        op.run(scope)
+        tensor = np.asarray(scope.get("X"))
+        self.assertEqual(tensor.shape, (1000, 784))
+        self.assertAlmostEqual(float(tensor.mean()), 2.5, delta=0.5)
+
+
+class TestScope(unittest.TestCase):
+    # reference tests/test_scope.py
+    def test_create_destroy(self):
+        from paddle.v2.framework.core import Scope
+
+        scope = Scope()
+        self.assertIsNotNone(scope)
+        child = scope.new_scope()
+        self.assertIsNotNone(child)
+
+    def test_create_var_get_var(self):
+        from paddle.v2.framework.core import Scope
+
+        scope = Scope()
+        var_a = scope.new_var("var_a")
+        self.assertIsNotNone(var_a)
+        self.assertIsNotNone(scope.find_var("var_a"))
+        child = scope.new_scope()
+        self.assertIsNotNone(child.find_var("var_a"))
+
+    def test_var_get_int(self):
+        from paddle.v2.framework.core import Scope
+
+        scope = Scope()
+        scope.set("test_int", 10)
+        self.assertEqual(scope.get("test_int"), 10)
+
+
+class TestNet(unittest.TestCase):
+    # reference tests/test_net.py — composite NetOp with
+    # CompleteAddOp I/O inference
+    def test_net_all(self):
+        from paddle.v2.framework.core import Scope
+        from paddle_tpu.framework import NetOp
+
+        net = NetOp()
+        net.add_op("add", {"X": "X", "Y": "Y"}, {"Out": "Out"})
+        net.add_op("mul", {"X": "Out", "Y": "W"}, {"Out": "FC"})
+        net.complete_add_op()
+        self.assertEqual(
+            sorted(net.inputs["X"]), ["W", "X", "Y"]
+        )
+        self.assertIn("FC", net.outputs["Out"])
+
+        rng = np.random.default_rng(15)
+        scope = Scope()
+        scope.set("X", rng.random((3, 4)).astype(np.float32))
+        scope.set("Y", rng.random((3, 4)).astype(np.float32))
+        scope.set("W", rng.random((4, 2)).astype(np.float32))
+        net.run(scope)
+        want = (
+            np.asarray(scope.get("X")) + np.asarray(scope.get("Y"))
+        ) @ np.asarray(scope.get("W"))
+        np.testing.assert_allclose(
+            np.asarray(scope.get("FC")), want, rtol=1e-5
+        )
+
+
+class TestBackwardOp(unittest.TestCase):
+    # reference tests/test_operator.py backward arm: core.Operator
+    # .backward builds the transposed net
+    def test_backward_of_mul(self):
+        from paddle.v2.framework import core
+        from paddle.v2.framework.core import Scope
+        from paddle.v2.framework.gradient_checker import grad_var_name
+
+        fwd = Operator("mul", X="A", Y="B", Out="C")
+        bwd = core.Operator.backward(fwd, set())
+        rng = np.random.default_rng(16)
+        a = rng.random((4, 6)).astype(np.float32)
+        b = rng.random((6, 3)).astype(np.float32)
+        scope = Scope()
+        scope.set("A", a)
+        scope.set("B", b)
+        fwd.run(scope)
+        scope.set(grad_var_name("C"), np.ones((4, 3), np.float32))
+        bwd.run(scope)
+        np.testing.assert_allclose(
+            np.asarray(scope.get(grad_var_name("A"))),
+            np.ones((4, 3), np.float32) @ b.T, rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(scope.get(grad_var_name("B"))),
+            a.T @ np.ones((4, 3), np.float32), rtol=1e-5,
+        )
+
 if __name__ == "__main__":
     unittest.main()
