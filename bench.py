@@ -199,6 +199,17 @@ def _build_arm_fused(conf, feed, opt_conf=None, inner=20):
     return warmup_fn, window_fn
 
 
+def _interleaved_best(window_fns: dict, rounds=5) -> dict:
+    """Round-robin the arms' timing windows and keep each arm's best —
+    the only honest A/B on the intermittently-preempted tunnel
+    (PERF.md methodology). All arms must already be warm."""
+    best = {k: float("inf") for k in window_fns}
+    for _ in range(rounds):
+        for k, fn in window_fns.items():
+            best[k] = min(best[k], fn())
+    return best
+
+
 def _time_train(conf, feed, opt_conf=None, iters=20, warmup=20,
                 windows=3, fused=False):
     """Build a Network + optimizer from `conf`, run `warmup` steps, then
@@ -281,10 +292,7 @@ def bench_lstm(bs, hidden):
     fus_w, fus_f = _build_arm_fused(conf, feed, opt, inner=10)
     seq_w(20)
     fus_w(2)
-    best = {"seq": float("inf"), "fused": float("inf")}
-    for _ in range(5):
-        best["seq"] = min(best["seq"], seq_f())
-        best["fused"] = min(best["fused"], fus_f())
+    best = _interleaved_best({"seq": seq_f, "fused": fus_f})
     ms = min(best.values())
     return {
         "value": round(ms, 3),
@@ -336,10 +344,7 @@ def bench_lstm_fused_vs_scan(bs=128, hidden=256):
         finally:
             _flags.set_flag("use_pallas_rnn", None)
 
-    best = {"scan": float("inf"), "fused": float("inf")}
-    for _ in range(5):
-        for arm_name, window_fn in arms.items():
-            best[arm_name] = min(best[arm_name], window_fn())
+    best = _interleaved_best(arms)
     scan_ms, fused_ms = best["scan"], best["fused"]
     from paddle_tpu.layers.recurrent import _use_fused
     from paddle_tpu.ops.pallas_rnn import _lstm_bwd_plan
@@ -550,10 +555,7 @@ def bench_resnet50(bs=256):
         )
         warmup_fn(20)
         arms[name] = window_fn
-    best = {k: float("inf") for k in arms}
-    for _ in range(3):
-        for name, window_fn in arms.items():
-            best[name] = min(best[name], window_fn())
+    best = _interleaved_best(arms, rounds=3)
     ms = min(best.values())
     img_s = bs / (ms / 1e3)
     mfu = img_s * RESNET50_TRAIN_FLOPS_PER_IMG / TPU_PEAK_FLOPS

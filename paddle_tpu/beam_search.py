@@ -191,6 +191,40 @@ class BeamSearchDecoder:
                     (b * k, m["size"]), m.get("boot_value", 0.0), jnp.float32
                 )
 
+        run = self._decode_program()
+        seqs, lens, scores = run(params, static_feed, init_carry_mem, b)
+        return seqs, lens, scores
+
+    def _decode_program(self):
+        """The whole decode (step net + while-loop + backtrace) as ONE
+        jitted program, cached on the decoder (keyed by the hook/logprob
+        closures; jax.jit handles shape-keyed retraces). Without this,
+        every generate() call re-traced the loop and paid seconds of
+        host tracing + compile-cache lookups per batch — measured 122
+        ms/decode-step at B=32 K=4 V=30k vs ~3 ms jitted."""
+        hk = (self.hooks.adjust, self.hooks.drop, self.hooks.stop,
+              self.logprob_fn)
+        cache = getattr(self, "_decode_cache", None)
+        if cache is None:
+            cache = self._decode_cache = {}
+        if hk not in cache:
+            # one jitted program per hook configuration — alternating
+            # hook setups keep their compiled traces. NB: jit a fresh
+            # closure, NOT the bound method: bound methods of the same
+            # instance compare equal, so jit wrappers around them share
+            # one trace cache and the second hook config would silently
+            # reuse the first config's compiled program.
+            def core(params, static_feed, init_carry_mem, b):
+                return self._decode_core(
+                    params, static_feed, init_carry_mem, b
+                )
+
+            cache[hk] = jax.jit(core, static_argnums=(3,))
+        return cache[hk]
+
+    def _decode_core(self, params, static_feed, init_carry_mem, b):
+        net = self._net
+        k = self.k
         hooks = self.hooks
         t_max = self.max_length
 
