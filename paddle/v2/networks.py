@@ -1,25 +1,43 @@
 """paddle.v2.networks — prebuilt network compositions.
 
 Reference: python/paddle/v2/networks.py re-exports
-trainer_config_helpers.networks under the same names
-(simple_img_conv_pool networks.py:145, img_conv_group :333,
-vgg_16_network :465, simple_lstm :548, simple_gru :975,
-bidirectional_lstm :1207, simple_attention :1298).
+trainer_config_helpers.networks under the same names — the FULL set
+(networks.py __all__): conv-pool groups (sequence_conv_pool :41 /
+text_conv_pool alias, simple_img_conv_pool :145, img_conv_bn_pool
+:232, img_conv_group :333, small_vgg :438, vgg_16_network :465), the
+rnn helpers (simple_lstm :548, lstmemory_unit :633, lstmemory_group
+:744, gru_unit :840, gru_group :902, simple_gru :975, simple_gru2
+:1061, bidirectional_gru :1122, bidirectional_lstm :1207) and
+simple_attention :1298.
 """
 
 from paddle_tpu.compat.layers_v1 import (
+    bidirectional_gru,
     bidirectional_lstm,
+    gru_group,
+    gru_unit,
+    img_conv_bn_pool,
     img_conv_group,
+    lstmemory_group,
+    lstmemory_unit,
+    sequence_conv_pool,
     simple_attention,
     simple_gru,
+    simple_gru2,
     simple_img_conv_pool,
     simple_lstm,
     small_vgg,
+    text_conv_pool,
     vgg_16_network,
 )
 
+# the reference v2 module deliberately EXCLUDES inputs/outputs from
+# its re-export (python/paddle/v2/networks.py skips them by name);
+# they remain available on the v1 surface (compat/config_parser)
 __all__ = [
-    "simple_img_conv_pool", "img_conv_group", "vgg_16_network",
-    "simple_lstm", "simple_gru", "bidirectional_lstm",
-    "simple_attention", "small_vgg",
+    "sequence_conv_pool", "simple_lstm", "simple_img_conv_pool",
+    "img_conv_bn_pool", "lstmemory_group", "lstmemory_unit",
+    "small_vgg", "img_conv_group", "vgg_16_network", "gru_unit",
+    "gru_group", "simple_gru", "simple_attention", "simple_gru2",
+    "bidirectional_gru", "text_conv_pool", "bidirectional_lstm",
 ]

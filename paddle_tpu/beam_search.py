@@ -202,11 +202,21 @@ class BeamSearchDecoder:
         every generate() call re-traced the loop and paid seconds of
         host tracing + compile-cache lookups per batch — measured 122
         ms/decode-step at B=32 K=4 V=30k vs ~3 ms jitted."""
+        # key on everything _decode_core closes over at trace time —
+        # hooks/logprob AND the scalar decode config (k/max_length/
+        # eos/bos): mutating decoder attributes after the first
+        # generate() must not silently reuse a stale compiled program
         hk = (self.hooks.adjust, self.hooks.drop, self.hooks.stop,
-              self.logprob_fn)
+              self.logprob_fn, self.k, self.max_length, self.eos_id,
+              self.bos_id)
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
+        if hk not in cache and len(cache) >= 8:
+            # bound the cache: fresh hook lambdas per call would
+            # otherwise grow it without limit (hooks should be stable
+            # objects; evict oldest insertion when they are not)
+            cache.pop(next(iter(cache)))
         if hk not in cache:
             # one jitted program per hook configuration — alternating
             # hook setups keep their compiled traces. NB: jit a fresh

@@ -172,6 +172,21 @@ def _slot_to_arg(s: dict):
             raise ValueError("sparse slot needs height/width > 0")
         rows = _read_i32(s["rows"], h + 1)
         cols = _read_i32(s["cols"], nnz)
+        # validate like the sequence slots do: a negative column index
+        # would wrap via numpy indexing and silently scatter into the
+        # wrong feature; malformed row offsets would drop/alias values
+        if ((cols < 0) | (cols >= w)).any():
+            raise ValueError(
+                f"sparse col indices must be in [0, {w}); got "
+                f"min={cols.min() if nnz else 0}, "
+                f"max={cols.max() if nnz else 0}"
+            )
+        if (np.diff(rows) < 0).any() or rows[0] != 0 or rows[-1] != nnz:
+            raise ValueError(
+                "sparse row offsets must be non-decreasing with "
+                f"rows[0]=0 and rows[{h}]=nnz={nnz}; got "
+                f"rows[0]={int(rows[0])}, rows[-1]={int(rows[-1])}"
+            )
         vals = (
             _read_f32(s["vals"], nnz)
             if kind == 5

@@ -100,7 +100,15 @@ __all__ = [
     "simple_attention",
     "simple_lstm",
     "simple_gru",
+    "simple_gru2",
     "bidirectional_lstm",
+    "bidirectional_gru",
+    "lstmemory_unit",
+    "lstmemory_group",
+    "gru_unit",
+    "gru_group",
+    "img_conv_bn_pool",
+    "text_conv_pool",
     "sequence_conv_pool",
     "simple_img_conv_pool",
     "img_conv_group",
@@ -753,12 +761,108 @@ def simple_lstm(input, size, name=None, act=None, reverse=False,
     return _apply_layer_attr(out, lstm_cell_attr)
 
 
-def simple_gru(input, size, name=None, act=None, reverse=False,
-               gru_cell_attr=None, **_):
+def simple_gru(input, size, name=None, act=None, gate_act=None,
+               reverse=False, gru_cell_attr=None, **_):
     """(networks.py:975 simple_gru)."""
     out = dsl.simple_gru(_one(input), size, name=name,
-                         act=_act_or(act, "tanh"), reversed=reverse)
+                         act=_act_or(act, "tanh"),
+                         gate_act=_act_or(gate_act, "sigmoid"),
+                         reversed=reverse)
     return _apply_layer_attr(out, gru_cell_attr)
+
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None,
+                   state_act=None, lstm_bias_attr=True, **_):
+    """(networks.py:633 lstmemory_unit) — one LSTM timestep for use
+    inside recurrent_group steps; input is the 4h pre-projection."""
+    return dsl.lstmemory_unit(
+        _one(input), size=size, name=name, out_memory=out_memory,
+        act=_act_or(act, "tanh"), gate_act=_act_or(gate_act, "sigmoid"),
+        state_act=_act_or(state_act, "tanh"), param=param_attr,
+        bias=bool(lstm_bias_attr),
+    )
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None,
+                    gate_act=None, state_act=None, lstm_bias_attr=True,
+                    **_):
+    """(networks.py:744 lstmemory_group)."""
+    return dsl.lstmemory_group(
+        _one(input), size=size, name=name, out_memory=out_memory,
+        reversed=reverse, act=_act_or(act, "tanh"),
+        gate_act=_act_or(gate_act, "sigmoid"),
+        state_act=_act_or(state_act, "tanh"), param=param_attr,
+        bias=bool(lstm_bias_attr),
+    )
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None,
+             gru_bias_attr=True, gru_param_attr=None, act=None,
+             gate_act=None, naive=False, **_):
+    """(networks.py:840 gru_unit) — one GRU timestep for
+    recurrent_group steps; input is the 3h pre-projection."""
+    return dsl.gru_unit(
+        _one(input), size=size, name=name, memory_boot=memory_boot,
+        act=_act_or(act, "tanh"), gate_act=_act_or(gate_act, "sigmoid"),
+        param=gru_param_attr, bias=bool(gru_bias_attr), naive=naive,
+    )
+
+
+def gru_group(input, memory_boot=None, size=None, name=None,
+              reverse=False, gru_bias_attr=True, gru_param_attr=None,
+              act=None, gate_act=None, naive=False, **_):
+    """(networks.py:902 gru_group)."""
+    return dsl.gru_group(
+        _one(input), size=size, name=name, memory_boot=memory_boot,
+        reversed=reverse, act=_act_or(act, "tanh"),
+        gate_act=_act_or(gate_act, "sigmoid"), param=gru_param_attr,
+        bias=bool(gru_bias_attr), naive=naive,
+    )
+
+
+def simple_gru2(input, size, name=None, reverse=False, act=None,
+                gate_act=None, **_):
+    """(networks.py:1061 simple_gru2)."""
+    return dsl.simple_gru2(_one(input), size, name=name,
+                           act=_act_or(act, "tanh"),
+                           gate_act=_act_or(gate_act, "sigmoid"),
+                           reversed=reverse)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_act=None, fwd_gate_act=None, **_):
+    """(networks.py:1122 bidirectional_gru). The fwd_* activations
+    apply to both directions (the reference defaults both directions
+    to the same activations unless overridden per side)."""
+    return dsl.bidirectional_gru(_one(input), size, name=name,
+                                 return_seq=return_seq,
+                                 act=_act_or(fwd_act, "tanh"),
+                                 gate_act=_act_or(fwd_gate_act,
+                                                  "sigmoid"))
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size,
+                     name=None, pool_type=None, act=None, groups=1,
+                     conv_stride=1, conv_padding=0, num_channel=None,
+                     conv_param_attr=None, pool_stride=1,
+                     pool_padding=0, **_):
+    """(networks.py:232 img_conv_bn_pool)."""
+    return dsl.img_conv_bn_pool(
+        _one(input), filter_size, num_filters, pool_size, name=name,
+        pool_type=_pool_type(pool_type), act=_act_or(act, "relu"),
+        groups=groups, conv_stride=conv_stride,
+        conv_padding=conv_padding, num_channel=num_channel,
+        conv_param=conv_param_attr, pool_stride=pool_stride,
+        pool_padding=pool_padding,
+    )
+
+
+def text_conv_pool(input, context_len, hidden_size, name=None, **kw):
+    """(networks.py:41 text_conv_pool = sequence_conv_pool alias)."""
+    return sequence_conv_pool(input, context_len, hidden_size,
+                              name=name, **kw)
 
 
 def bidirectional_lstm(input, size, name=None, return_seq=False, **_):

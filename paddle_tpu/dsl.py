@@ -351,10 +351,12 @@ def simple_lstm(x, size, name=None, act="tanh", reversed=False):
     return lstmemory(proj, size=size, name=name, act=act, reversed=reversed)
 
 
-def simple_gru(x, size, name=None, act="tanh", reversed=False):
+def simple_gru(x, size, name=None, act="tanh", gate_act="sigmoid",
+               reversed=False):
     """(networks.py:975 simple_gru)."""
     proj = fc(x, size=size * 3, name=(name or "gru") + "_proj", bias=True)
-    return grumemory(proj, size=size, name=name, act=act, reversed=reversed)
+    return grumemory(proj, size=size, name=name, act=act,
+                     gate_act=gate_act, reversed=reversed)
 
 
 def bidirectional_lstm(x, size, name=None, return_concat=True):
@@ -362,6 +364,139 @@ def bidirectional_lstm(x, size, name=None, return_concat=True):
     fwd = simple_lstm(x, size, name=(name or "bilstm") + "_fwd")
     bwd = simple_lstm(x, size, name=(name or "bilstm") + "_bwd", reversed=True)
     return concat(fwd, bwd) if return_concat else (fwd, bwd)
+
+
+# ---- step-level rnn units/groups (networks.py:633-1122) ----
+# The 2017-era building blocks seq2seq configs compose inside
+# recurrent_group: one-timestep cells over memory() links, and their
+# prebuilt recurrent_group wrappers. Cell math lives in layers/steps.py
+# (lstm_step/gru_step); here is only the wiring.
+
+def lstmemory_unit(x, size=None, name=None, out_memory=None, act="tanh",
+                   gate_act="sigmoid", state_act="tanh", param=None,
+                   bias=True):
+    """One LSTM timestep inside a recurrent_group step
+    (networks.py:633 lstmemory_unit). `x` must already carry the
+    input-to-hidden projection (width 4*size — the reference's
+    convention of hoisting W_x*x out of the unit). Unlike the
+    reference, the hidden-to-hidden projection lives INSIDE lstm_step
+    (its `w0`, layout-compatible with lstmemory so weights transfer) —
+    no `%s_input_recurrent` mixed layer is needed. A `{name}_state`
+    layer exposes c_t so the state memory links to it."""
+    if size is None:
+        assert x.size % 4 == 0, f"lstmemory_unit input {x.size} % 4 != 0"
+        size = x.size // 4
+    name = name or current().uniq("lstmemory_unit")
+    out_mem = out_memory if out_memory is not None else memory(
+        name, size=size
+    )
+    state_mem = memory(f"{name}_state", size=size)
+    lstm_out = _add("lstm_step", [x, out_mem, state_mem], name=name,
+                    size=size, act=act, bias=bias, param=param,
+                    active_gate_type=gate_act,
+                    active_state_type=state_act)
+    get_output(lstm_out, "state", name=f"{name}_state")
+    return lstm_out
+
+
+def lstmemory_group(x, size=None, name=None, out_memory=None,
+                    reversed=False, act="tanh", gate_act="sigmoid",
+                    state_act="tanh", param=None, bias=True):
+    """recurrent_group-built LSTM over a sequence already projected to
+    4*size (networks.py:744 lstmemory_group) — same math as lstmemory,
+    with every step's hidden/cell state addressable by step-net layer
+    name (the attention-model use case)."""
+    if size is None:
+        assert x.size % 4 == 0, f"lstmemory_group input {x.size} % 4 != 0"
+        size = x.size // 4
+    name = name or current().uniq("lstm_group")
+
+    def step(ipt):
+        return lstmemory_unit(
+            ipt, size=size, name=name, out_memory=out_memory, act=act,
+            gate_act=gate_act, state_act=state_act, param=param,
+            bias=bias,
+        )
+
+    return recurrent_group(step, [x], name=f"{name}_recurrent_group",
+                           reversed=reversed)
+
+
+def gru_unit(x, size=None, name=None, memory_boot=None, act="tanh",
+             gate_act="sigmoid", param=None, bias=True, naive=False):
+    """One GRU timestep inside a recurrent_group step (networks.py:840
+    gru_unit). `x` must already be the 3*size gate pre-projection."""
+    if size is None:
+        assert x.size % 3 == 0, f"gru_unit input {x.size} % 3 != 0"
+        size = x.size // 3
+    name = name or current().uniq("gru_unit")
+    out_mem = memory(name, size=size, boot_layer=memory_boot)
+    return _add("gru_step_naive" if naive else "gru_step", [x, out_mem],
+                name=name, size=size, act=act, bias=bias, param=param,
+                active_gate_type=gate_act)
+
+
+def gru_group(x, size=None, name=None, memory_boot=None, reversed=False,
+              act="tanh", gate_act="sigmoid", param=None, bias=True,
+              naive=False):
+    """recurrent_group-built GRU over a 3*size-projected sequence
+    (networks.py:902 gru_group) — grumemory math with per-step hidden
+    states addressable inside the group."""
+    if size is None:
+        assert x.size % 3 == 0, f"gru_group input {x.size} % 3 != 0"
+        size = x.size // 3
+    name = name or current().uniq("gru_group")
+
+    def step(ipt):
+        return gru_unit(ipt, size=size, name=name,
+                        memory_boot=memory_boot, act=act,
+                        gate_act=gate_act, param=param, bias=bias,
+                        naive=naive)
+
+    return recurrent_group(step, [x], name=f"{name}_recurrent_group",
+                           reversed=reversed)
+
+
+def simple_gru2(x, size, name=None, act="tanh", gate_act="sigmoid",
+                reversed=False):
+    """fc(3h) + grumemory (networks.py:1061 simple_gru2 — the faster
+    formulation of simple_gru; here both lower to the same scanned
+    cell, the distinction is per-step state addressability only)."""
+    name = name or current().uniq("gru2")
+    proj = fc(x, size=size * 3, name=f"{name}_transform", bias=True)
+    return grumemory(proj, size=size, name=name, act=act,
+                     gate_act=gate_act, reversed=reversed)
+
+
+def bidirectional_gru(x, size, name=None, return_seq=False, act="tanh",
+                      gate_act="sigmoid"):
+    """(networks.py:1122 bidirectional_gru). return_seq=False concats
+    the forward last / backward first frames; True concats the full
+    output sequences."""
+    name = name or current().uniq("bigru")
+    fwd = simple_gru2(x, size, name=f"{name}_fw", act=act,
+                      gate_act=gate_act)
+    bwd = simple_gru2(x, size, name=f"{name}_bw", act=act,
+                      gate_act=gate_act, reversed=True)
+    if return_seq:
+        return concat(fwd, bwd, name=name)
+    return concat(last_seq(fwd), first_seq(bwd), name=name)
+
+
+def img_conv_bn_pool(x, filter_size, num_filters, pool_size, name=None,
+                     pool_type="max", act="relu", groups=1,
+                     conv_stride=1, conv_padding=0, num_channel=None,
+                     conv_param=None, pool_stride=1, pool_padding=0):
+    """conv -> batch_norm(act) -> pool (networks.py:232
+    img_conv_bn_pool)."""
+    name = name or current().uniq("conv_bn_pool")
+    c = conv(x, num_filters, filter_size, stride=conv_stride,
+             padding=conv_padding, groups=groups, act="",
+             param=conv_param, num_channels=num_channel,
+             name=f"{name}_conv")
+    bn = batch_norm(c, act=act, name=f"{name}_bn")
+    return pool(bn, pool_size, pool_stride, padding=pool_padding,
+                pool_type=pool_type, name=f"{name}_pool")
 
 
 # ---- sequence structure ----

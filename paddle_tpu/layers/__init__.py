@@ -9,6 +9,7 @@ from paddle_tpu.layers import (  # noqa: F401
     detection,
     extras,
     fused,
+    fused_text,
     moe,
     norm,
     pool,

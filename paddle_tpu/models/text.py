@@ -158,11 +158,20 @@ def seq2seq_attention(
     trg_vocab=30000,
     emb_dim=128,
     hidden=256,
+    fused_decoder=True,
 ) -> ModelConf:
     """Attention NMT trainer config (the quick_start seqToseq demo /
     SURVEY.md north-star NMT). Teacher forcing: decoder consumes
     `trg_in` (BOS-prefixed) and is scored against `trg_out` (EOS-suffixed).
-    Encoder hidden size = `hidden` (bidi concat of hidden/2 each)."""
+    Encoder hidden size = `hidden` (bidi concat of hidden/2 each).
+
+    fused_decoder=True runs the decoder recurrence as the fused layer
+    (layers/fused_text.py: hoisted input/context projections, merged
+    prev-GEMMs — identical math and parameter names, measured faster;
+    the r4 roofline showed the step latency-bound on the scan's serial
+    op chain). False keeps the generic recurrent_group lowering of the
+    same step net (the A/B arm, and the proof the config DSL path
+    trains the north star end to end)."""
     from paddle_tpu import dsl
     from paddle_tpu.core.config import InputConf, ParameterConf
 
@@ -189,19 +198,31 @@ def seq2seq_attention(
         # (its scan runs right-to-left and is re-reversed to time order)
         enc_summary = dsl.first_seq(bwd, name="enc_summary")
         boot = dsl.fc(enc_summary, size=hidden, act="tanh", name="dec_boot")
-        states = dsl.recurrent_group(
-            step, [trg_in, dsl.StaticInput(enc)], name="decoder"
-        )
+        if fused_decoder:
+            trg_emb = dsl.embedding(
+                trg_in, size=emb_dim, vocab_size=trg_vocab,
+                param=ParameterConf(name="trg_emb"),
+                name="trg_emb_lookup",
+            )
+            states = dsl._add(
+                "fused_att_decoder", [trg_emb, enc, boot],
+                name="decoder", size=hidden, bias=True,
+            )
+        else:
+            states = dsl.recurrent_group(
+                step, [trg_in, dsl.StaticInput(enc)], name="decoder"
+            )
         prob = dsl.fc(states, size=trg_vocab, act="softmax",
                       name="dec_prob")
         dsl.cross_entropy(prob, trg_out, name="cost")
         g.conf.output_layer_names.append("dec_prob")
-    # wire the decoder-state boot to the parent layer
-    rg = g.conf.layer("decoder")
-    for m in rg.attrs["memories"]:
-        if m["layer"] == "dec_state":
-            m["boot_layer"] = "dec_boot"
-    rg.inputs.append(InputConf("dec_boot"))
+    if not fused_decoder:
+        # wire the decoder-state boot to the parent layer
+        rg = g.conf.layer("decoder")
+        for m in rg.attrs["memories"]:
+            if m["layer"] == "dec_state":
+                m["boot_layer"] = "dec_boot"
+        rg.inputs.append(InputConf("dec_boot"))
     return g.conf
 
 

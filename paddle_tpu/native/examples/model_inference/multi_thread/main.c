@@ -11,6 +11,7 @@
  * usage: main LIBPATH REPOPATH MERGED_MODEL OUTPUT_LAYER
  */
 #include <pthread.h>
+#include <signal.h>
 #include <string.h>
 
 #include "../common/common.h"
@@ -20,7 +21,9 @@
 
 static pt_api pt;
 static int64_t g_h;
-static int g_failed = 0;
+/* written from worker threads, read after join — keep the flag atomic
+ * so the template users copy for threaded serving is race-free */
+static volatile sig_atomic_t g_failed = 0;
 
 static void* thread_main(void* arg) {
   long tid = (long)arg;

@@ -240,7 +240,7 @@ int pt_capi_forward_slots(int64_t handle, const pt_capi_slot* slots,
     }
     PyObject* dict =
         shp ? Py_BuildValue(
-                  "{s:s, s:i, s:L, s:N, s:L, s:i, s:L, s:i, s:L, s:L, "
+                  "{s:s, s:i, s:L, s:O, s:L, s:i, s:L, s:i, s:L, s:L, "
                   "s:L, s:L, s:L, s:L}",
                   "name", s.name ? s.name : "", "kind", s.kind, "buf",
                   (long long)(intptr_t)s.buf, "shape", shp, "seq_pos",
@@ -252,6 +252,11 @@ int pt_capi_forward_slots(int64_t handle, const pt_capi_slot* slots,
                   (long long)(intptr_t)s.vals, "height",
                   (long long)s.height, "nnz", (long long)s.nnz)
             : nullptr;
+    // "O" borrows shp (increfs on use), so this frame's reference is
+    // released unconditionally — leak-free on failure without the
+    // double-decref a "N" + manual-clear pairing risks when the dict
+    // builder fails AFTER consuming the shape pair
+    Py_XDECREF(shp);
     if (!dict) {
       ok = false;
       break;

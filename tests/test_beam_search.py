@@ -39,6 +39,40 @@ def test_beam_finds_best_bigram_path():
     assert np.all(np.diff(s, axis=1) <= 1e-6)
 
 
+def test_decode_program_not_stale_after_config_mutation():
+    """Mutating decode config (max_length/beam/eos) after the first
+    generate() must produce a fresh compiled program, not silently
+    reuse the stale one (ADVICE r4: cache keyed only on hooks)."""
+    v, eos = 5, 1
+
+    def step(word):
+        emb = dsl.embedding(word, size=v, vocab_size=v,
+                            param=ParameterConf(name="bigram_cfg"))
+        return dsl.mixed(v, [(emb, "identity")], act="softmax",
+                         bias=False, name="prob")
+
+    dec = BeamSearchDecoder(step, n_static=0, bos_id=0, eos_id=eos,
+                            beam_size=4, max_length=6)
+    # uniform-ish chain that never emits EOS: length = max_length
+    table = np.full((v, v), 0.0, np.float32)
+    table[:, eos] = -50.0
+    params = {"bigram_cfg": jnp.asarray(table)}
+    seqs, lens, _ = dec.generate(params, statics=[], batch_size=1)
+    assert np.asarray(seqs).shape[2] == 6
+    dec.max_length = 3
+    seqs2, lens2, _ = dec.generate(params, statics=[], batch_size=1)
+    assert np.asarray(seqs2).shape[2] == 3
+    assert np.asarray(lens2).max() <= 3
+    dec.k = 2
+    seqs3, _, _ = dec.generate(params, statics=[], batch_size=1)
+    assert np.asarray(seqs3).shape[1] == 2
+    # the cache stays bounded even under fresh hook lambdas per call
+    for i in range(6):
+        dec.hooks = type(dec.hooks)(adjust=lambda lp, t, i=i: lp)
+        dec.generate(params, statics=[], batch_size=1)
+    assert len(dec._decode_cache) <= 8
+
+
 def test_beam_with_decoder_state_and_encoder():
     """Attention-free seq2seq decoder: state memory booted from encoder
     summary; checks shapes, finiteness, and that generation is

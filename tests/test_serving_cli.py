@@ -523,6 +523,38 @@ class TestBridgeSlots:
         want[0, 1], want[0, 4], want[1, 0] = 0.5, -2.0, 3.0
         np.testing.assert_array_equal(np.asarray(arg.value), want)
 
+    def test_sparse_bad_cols_rejected(self):
+        """Negative / out-of-range column indices must fail loudly —
+        numpy negative indexing would otherwise silently scatter the
+        value into the wrong feature."""
+        from paddle_tpu import capi_bridge as cb
+
+        rows = np.asarray([0, 2, 3], np.int32)
+        vals = np.asarray([1.0, 2.0, 3.0], np.float32)
+        for bad in ([1, -1, 0], [1, 6, 0]):
+            cols = np.asarray(bad, np.int32)
+            with pytest.raises(ValueError, match="col indices"):
+                cb._slot_to_arg(self._slot(
+                    kind=5, rows=self._addr(rows),
+                    cols=self._addr(cols), vals=self._addr(vals),
+                    height=2, width=6, nnz=3,
+                ))
+
+    def test_sparse_bad_rows_rejected(self):
+        from paddle_tpu import capi_bridge as cb
+
+        cols = np.asarray([1, 4, 0], np.int32)
+        vals = np.asarray([1.0, 2.0, 3.0], np.float32)
+        for bad in ([0, 3, 2], [0, 2, 2], [1, 2, 3]):  # decreasing /
+            # rows[-1] != nnz / rows[0] != 0
+            rows = np.asarray(bad, np.int32)
+            with pytest.raises(ValueError, match="row offsets"):
+                cb._slot_to_arg(self._slot(
+                    kind=5, rows=self._addr(rows),
+                    cols=self._addr(cols), vals=self._addr(vals),
+                    height=2, width=6, nnz=3,
+                ))
+
     def test_seq_dense_slot(self):
         from paddle_tpu import capi_bridge as cb
 
