@@ -86,23 +86,7 @@ def _ensure_devices(pattern):
     )
 
 
-def _setup():
-    import jax
-
-    from paddle_tpu.core import flags as _flags
-
-    _flags.set_flag("matmul_precision", "bfloat16")
-    jax.config.update("jax_default_prng_impl", "rbg")
-    try:
-        cache_dir = os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache"
-        )
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update(
-            "jax_persistent_cache_min_compile_time_secs", 0.3
-        )
-    except Exception:
-        pass
+from bench import _setup  # one source of truth for AMP/PRNG/cache setup
 
 
 def _mesh_arm(conf, feed, opt_conf, mesh, iters):

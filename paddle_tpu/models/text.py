@@ -158,7 +158,7 @@ def seq2seq_attention(
     trg_vocab=30000,
     emb_dim=128,
     hidden=256,
-    fused_decoder=True,
+    fused_decoder=False,
 ) -> ModelConf:
     """Attention NMT trainer config (the quick_start seqToseq demo /
     SURVEY.md north-star NMT). Teacher forcing: decoder consumes
@@ -167,11 +167,13 @@ def seq2seq_attention(
 
     fused_decoder=True runs the decoder recurrence as the fused layer
     (layers/fused_text.py: hoisted input/context projections, merged
-    prev-GEMMs — identical math and parameter names, measured faster;
-    the r4 roofline showed the step latency-bound on the scan's serial
-    op chain). False keeps the generic recurrent_group lowering of the
-    same step net (the A/B arm, and the proof the config DSL path
-    trains the north star end to end)."""
+    prev-GEMMs — identical math and parameter names). Built to test
+    the r4 hypothesis that the step was bound on the scan's serial op
+    chain; MEASURED LOSING 0.93x on a healthy chip (PERF.md round 5 —
+    the hypothesis was wrong, XLA's scan lowering was not
+    overhead-bound), so it ships opt-in and the bench NMT row keeps a
+    permanent plain-vs-fused A/B tripwire. False (default) is the
+    generic recurrent_group lowering of the step net."""
     from paddle_tpu import dsl
     from paddle_tpu.core.config import InputConf, ParameterConf
 

@@ -13,28 +13,44 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_multichip_bench_cpu_mesh_smoke():
-    # one LSTM row via the PATTERN filter keeps the one-core CI cheap;
-    # the subprocess starts on the pinned platform and must re-exec
-    # itself onto the forced 8-device CPU mesh
+    # one LSTM row via the PATTERN filter keeps the one-core CI cheap.
+    # Strip any pre-set virtual-device-count from XLA_FLAGS so the
+    # subprocess deterministically starts single-device and exercises
+    # the re-exec onto the forced 8-device CPU mesh (on a box attached
+    # to a real multi-chip slice the re-exec is skipped by design —
+    # that path asserts the real-slice row shape instead).
+    env = {**os.environ}
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    )
     r = subprocess.run(
         [sys.executable, "bench_multichip.py", "mc_lstm_h256_tbs256"],
         capture_output=True, text=True, cwd=REPO, timeout=420,
-        env={**os.environ},
+        env=env,
     )
     assert r.returncode == 0, r.stderr[-3000:]
     lines = [json.loads(ln) for ln in r.stdout.splitlines()
              if ln.startswith("{")]
     by_name = {ln["metric"]: ln for ln in lines}
     cfg = by_name["mc_config"]
-    assert cfg["devices"] == 8 and cfg["synthetic"] is True
-    row = by_name["mc_lstm_h256_tbs256_dp8"]
+    n = cfg["devices"]
+    assert n >= 2
+    row = by_name[f"mc_lstm_h256_tbs256_dp{n}"]
     assert row.get("error") is None
     assert row["value"] > 0
-    assert row["devices"] == 8
-    assert row["synthetic"] is True
-    assert row["per_device_batch"] * 8 == row["total_batch"]
-    # a synthetic row must not claim a baseline comparison
-    assert "vs_baseline" not in row and "speedup" not in row
+    assert row["devices"] == n
+    assert row["per_device_batch"] * n == row["total_batch"]
+    if cfg["synthetic"]:
+        # single-device start re-exec'd onto the virtual CPU mesh: a
+        # synthetic row must not claim a baseline comparison
+        assert n == 8
+        assert row["synthetic"] is True
+        assert "vs_baseline" not in row and "speedup" not in row
+    else:
+        # genuine multi-chip hardware: the real-throughput row shape
+        assert "synthetic" not in row
+        assert row["vs_baseline"] > 0 and row["speedup"] > 0
 
 
 def test_multichip_rows_cover_reference_matrix():
