@@ -117,6 +117,8 @@ __all__ = [
     "sub_nested_seq_layer",
     "get_output_layer",
     "memory",
+    "StaticInput",
+    "SubsequenceInput",
     "recurrent_group",
     # activations (attrs-style classes)
     "LinearActivation",
@@ -251,7 +253,21 @@ def fc_layer(input, size, act=None, name=None, bias_attr=True,
     # reference default activation for fc is tanh (layers.py:949
     # wrap_act_default); an explicit LinearActivation() stays linear
     b, bp = _bias(bias_attr)
-    out = dsl.fc(*_many(input), size=size, name=name,
+    ins = _many(input)
+    if isinstance(param_attr, (list, tuple)):
+        # per-input param attrs (layers.py fc_layer accepts one per
+        # edge; shared_lstm shares one softmax_param across both) —
+        # attach each to its edge directly
+        from paddle_tpu.core.config import InputConf
+
+        assert len(param_attr) == len(ins), (
+            f"fc_layer: {len(ins)} inputs but {len(param_attr)} "
+            "param_attr entries (the reference requires one per edge)"
+        )
+        ins = [InputConf(name=x.name, parameter=p)
+               for x, p in zip(ins, param_attr)]
+        param_attr = None
+    out = dsl.fc(*ins, size=size, name=name,
                  act=_act_or(act, "tanh"),
                  bias=b, bias_param=bp, param=param_attr)
     return _apply_layer_attr(out, layer_attr)
@@ -467,6 +483,14 @@ class _MixedLayerBuilder:
     def builder(self):
         return self._ref.builder
 
+    @property
+    def size(self):
+        """Width of the finished layer (LayerOutput.size) — the group
+        helpers infer their cell size from it (shared_lstm passes a
+        mixed builder straight into lstmemory_group)."""
+        assert self._ref is not None, "mixed_layer context not exited yet"
+        return self._ref.size
+
     # arithmetic works like any layer handle (layer_math patches these
     # onto LayerRef; delegate to the finished ref)
     def __add__(self, other):
@@ -515,11 +539,25 @@ def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0, **_):
 # edge spec dsl.mixed consumes ----
 
 def full_matrix_projection(input, size=0, param_attr=None, **_):
-    return (_one(input), "full_matrix")
+    # the projection's own size/param ride the edge: a sizeless
+    # mixed_layer infers its width from the projection's declared size
+    # (reference mixed_layer(size=None) idiom), and a named param_attr
+    # shares the projection weight across mixed layers (shared_lstm)
+    extra = {}
+    if param_attr is not None:
+        extra["param"] = param_attr
+    if size:
+        extra["proj_size"] = size
+    return (_one(input), "full_matrix", extra)
 
 
 def trans_full_matrix_projection(input, size=0, param_attr=None, **_):
-    return (_one(input), "trans_full_matrix")
+    extra = {}
+    if param_attr is not None:
+        extra["param"] = param_attr
+    if size:
+        extra["proj_size"] = size
+    return (_one(input), "trans_full_matrix", extra)
 
 
 def identity_projection(input, offset=None, **_):
@@ -706,8 +744,49 @@ def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
 
 # ---- costs ----
 
+def _effective_act(conf, name, depth=8):
+    """The activation the values flowing out of `name` went through,
+    traced through pass-through wrappers: single-input addto/dropout
+    forwards its input's activation when itself linear, and a
+    recurrent_group's output carries its step-net out-link's
+    activation. Depth-capped; unknown -> ""."""
+    while depth > 0:
+        depth -= 1
+        try:
+            lc = conf.layer(name)
+        except Exception:
+            return ""
+        if lc.active_type:
+            return lc.active_type
+        if lc.type == "recurrent_group":
+            conf = lc.attrs["step_conf"]
+            name = lc.attrs["out_links"][0]
+            continue
+        if (
+            lc.type in ("addto", "seqlastins", "seqreverse")
+            and len(lc.inputs) == 1
+        ):
+            # value-preserving wrappers: dropout/identity addto and
+            # frame selectors (last_seq/first_seq/seq_reverse) carry
+            # their input's distribution through unchanged
+            name = lc.inputs[0].name
+            continue
+        return ""
+    return ""
+
+
 def classification_cost(input, label, name=None, coeff=1.0, **_):
-    return dsl.classification_cost(input, label, name=name, coeff=coeff)
+    """Reference classification_cost = multi-class CE on the input
+    DISTRIBUTION (the v1 idiom puts act=Softmax on the input fc;
+    CostLayer.cpp MultiClassCrossEntropy reads probabilities). Route a
+    softmax-activated input to the prob-CE layer — mapping it onto the
+    fused logits-CE would double-softmax and floor the loss at
+    -ln(sigmoid(max_margin)). A non-softmax input keeps the fused
+    softmax+CE composite (same math the reference composes)."""
+    x = _one(input)
+    if _effective_act(x.builder.conf, x.name) == "softmax":
+        return dsl.cross_entropy(x, label, name=name, coeff=coeff)
+    return dsl.classification_cost(x, label, name=name, coeff=coeff)
 
 
 def cross_entropy(input, label, name=None, coeff=1.0, **_):
@@ -775,12 +854,15 @@ def lstmemory_unit(input, out_memory=None, name=None, size=None,
                    param_attr=None, act=None, gate_act=None,
                    state_act=None, lstm_bias_attr=True, **_):
     """(networks.py:633 lstmemory_unit) — one LSTM timestep for use
-    inside recurrent_group steps; input is the 4h pre-projection."""
+    inside recurrent_group steps; input is the 4h pre-projection.
+    lstm_bias_attr may be a ParamAttr carrying a SHARED bias name
+    (the reference shared_lstm config)."""
+    b, bp = _bias(lstm_bias_attr)
     return dsl.lstmemory_unit(
         _one(input), size=size, name=name, out_memory=out_memory,
         act=_act_or(act, "tanh"), gate_act=_act_or(gate_act, "sigmoid"),
         state_act=_act_or(state_act, "tanh"), param=param_attr,
-        bias=bool(lstm_bias_attr),
+        bias=b, bias_param=bp,
     )
 
 
@@ -789,12 +871,13 @@ def lstmemory_group(input, size=None, name=None, out_memory=None,
                     gate_act=None, state_act=None, lstm_bias_attr=True,
                     **_):
     """(networks.py:744 lstmemory_group)."""
+    b, bp = _bias(lstm_bias_attr)
     return dsl.lstmemory_group(
         _one(input), size=size, name=name, out_memory=out_memory,
         reversed=reverse, act=_act_or(act, "tanh"),
         gate_act=_act_or(gate_act, "sigmoid"),
         state_act=_act_or(state_act, "tanh"), param=param_attr,
-        bias=bool(lstm_bias_attr),
+        bias=b, bias_param=bp,
     )
 
 
@@ -803,10 +886,11 @@ def gru_unit(input, memory_boot=None, size=None, name=None,
              gate_act=None, naive=False, **_):
     """(networks.py:840 gru_unit) — one GRU timestep for
     recurrent_group steps; input is the 3h pre-projection."""
+    b, bp = _bias(gru_bias_attr)
     return dsl.gru_unit(
         _one(input), size=size, name=name, memory_boot=memory_boot,
         act=_act_or(act, "tanh"), gate_act=_act_or(gate_act, "sigmoid"),
-        param=gru_param_attr, bias=bool(gru_bias_attr), naive=naive,
+        param=gru_param_attr, bias=b, bias_param=bp, naive=naive,
     )
 
 
@@ -814,11 +898,12 @@ def gru_group(input, memory_boot=None, size=None, name=None,
               reverse=False, gru_bias_attr=True, gru_param_attr=None,
               act=None, gate_act=None, naive=False, **_):
     """(networks.py:902 gru_group)."""
+    b, bp = _bias(gru_bias_attr)
     return dsl.gru_group(
         _one(input), size=size, name=name, memory_boot=memory_boot,
         reversed=reverse, act=_act_or(act, "tanh"),
         gate_act=_act_or(gate_act, "sigmoid"), param=gru_param_attr,
-        bias=bool(gru_bias_attr), naive=naive,
+        bias=b, bias_param=bp, naive=naive,
     )
 
 
@@ -977,8 +1062,25 @@ def memory(name, size, boot_layer=None, **_):
     return dsl.memory(name, size=size, boot_layer=boot_layer)
 
 
+class SubsequenceInput:
+    """(layers.py SubsequenceInput) — marks a recurrent_group in-link
+    whose OUTER iteration walks subsequences. The scan executor keys
+    off the input spec's has_subseq (layers/recurrent_group.py), so
+    this unwraps to the underlying layer at group-build time."""
+
+    def __init__(self, input):
+        self.input = _one(input)
+
+
+def StaticInput(input, is_seq=False, size=None, **_):
+    """(layers.py StaticInput) — whole-sequence read-only in-link."""
+    return dsl.StaticInput(_one(input))
+
+
 def recurrent_group(step, input, name=None, reverse=False, **_):
-    return dsl.recurrent_group(step, _many(input), name=name,
+    ins = [x.input if isinstance(x, SubsequenceInput) else x
+           for x in _many(input)]
+    return dsl.recurrent_group(step, ins, name=name,
                                reversed=reverse)
 
 

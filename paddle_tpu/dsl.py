@@ -222,6 +222,18 @@ def mixed(size, inputs, name=None, act="", bias=True):
             if inferred:
                 size = inferred
                 break
+    # a projection's declared size must agree with the layer width —
+    # the reference config parser rejects the mismatch at parse time,
+    # and silently coercing would build different dimensions than the
+    # config author wrote
+    for ic in ins:
+        ps = ic.attrs.get("proj_size")
+        if ps and size and ps != size:
+            raise ValueError(
+                f"mixed layer {name or '?'}: projection on "
+                f"{ic.name!r} declares size {ps} but the layer is "
+                f"{size} wide"
+            )
     return _add("mixed", ins, name=name, size=size, act=act, bias=bias)
 
 
@@ -234,6 +246,10 @@ def mixed_proj_size(proj, in_size, attrs):
         return in_size
     if proj == "context":
         return in_size * attrs["context_length"]
+    if proj in ("full_matrix", "trans_full_matrix"):
+        # a projection may declare its own output width
+        # (full_matrix_projection(size=...) under a sizeless mixed)
+        return attrs.get("proj_size") or None
     return None
 
 
@@ -374,7 +390,7 @@ def bidirectional_lstm(x, size, name=None, return_concat=True):
 
 def lstmemory_unit(x, size=None, name=None, out_memory=None, act="tanh",
                    gate_act="sigmoid", state_act="tanh", param=None,
-                   bias=True):
+                   bias=True, bias_param=None):
     """One LSTM timestep inside a recurrent_group step
     (networks.py:633 lstmemory_unit). `x` must already carry the
     input-to-hidden projection (width 4*size — the reference's
@@ -393,6 +409,7 @@ def lstmemory_unit(x, size=None, name=None, out_memory=None, act="tanh",
     state_mem = memory(f"{name}_state", size=size)
     lstm_out = _add("lstm_step", [x, out_mem, state_mem], name=name,
                     size=size, act=act, bias=bias, param=param,
+                    bias_param=bias_param,
                     active_gate_type=gate_act,
                     active_state_type=state_act)
     get_output(lstm_out, "state", name=f"{name}_state")
@@ -401,7 +418,8 @@ def lstmemory_unit(x, size=None, name=None, out_memory=None, act="tanh",
 
 def lstmemory_group(x, size=None, name=None, out_memory=None,
                     reversed=False, act="tanh", gate_act="sigmoid",
-                    state_act="tanh", param=None, bias=True):
+                    state_act="tanh", param=None, bias=True,
+                    bias_param=None):
     """recurrent_group-built LSTM over a sequence already projected to
     4*size (networks.py:744 lstmemory_group) — same math as lstmemory,
     with every step's hidden/cell state addressable by step-net layer
@@ -415,7 +433,7 @@ def lstmemory_group(x, size=None, name=None, out_memory=None,
         return lstmemory_unit(
             ipt, size=size, name=name, out_memory=out_memory, act=act,
             gate_act=gate_act, state_act=state_act, param=param,
-            bias=bias,
+            bias=bias, bias_param=bias_param,
         )
 
     return recurrent_group(step, [x], name=f"{name}_recurrent_group",
@@ -423,7 +441,8 @@ def lstmemory_group(x, size=None, name=None, out_memory=None,
 
 
 def gru_unit(x, size=None, name=None, memory_boot=None, act="tanh",
-             gate_act="sigmoid", param=None, bias=True, naive=False):
+             gate_act="sigmoid", param=None, bias=True,
+             bias_param=None, naive=False):
     """One GRU timestep inside a recurrent_group step (networks.py:840
     gru_unit). `x` must already be the 3*size gate pre-projection."""
     if size is None:
@@ -433,12 +452,12 @@ def gru_unit(x, size=None, name=None, memory_boot=None, act="tanh",
     out_mem = memory(name, size=size, boot_layer=memory_boot)
     return _add("gru_step_naive" if naive else "gru_step", [x, out_mem],
                 name=name, size=size, act=act, bias=bias, param=param,
-                active_gate_type=gate_act)
+                bias_param=bias_param, active_gate_type=gate_act)
 
 
 def gru_group(x, size=None, name=None, memory_boot=None, reversed=False,
               act="tanh", gate_act="sigmoid", param=None, bias=True,
-              naive=False):
+              bias_param=None, naive=False):
     """recurrent_group-built GRU over a 3*size-projected sequence
     (networks.py:902 gru_group) — grumemory math with per-step hidden
     states addressable inside the group."""
@@ -451,7 +470,7 @@ def gru_group(x, size=None, name=None, memory_boot=None, reversed=False,
         return gru_unit(ipt, size=size, name=name,
                         memory_boot=memory_boot, act=act,
                         gate_act=gate_act, param=param, bias=bias,
-                        naive=naive)
+                        bias_param=bias_param, naive=naive)
 
     return recurrent_group(step, [x], name=f"{name}_recurrent_group",
                            reversed=reversed)
@@ -544,27 +563,43 @@ class StaticInput:
         self.ref = ref
 
 
+class MemoryRef(LayerRef):
+    """LayerRef for a memory link that also carries the memory record,
+    so the reference's deferred-binding idiom works: `m = memory(
+    name=None, size=...); ... ; m.set_input(layer)` (layers.py memory
+    set_input — used by e.g. the reference test_rnn_group config)."""
+
+    def __init__(self, name, builder, record):
+        super().__init__(name, builder)
+        object.__setattr__(self, "_record", record)
+
+    def set_input(self, layer):
+        self._record["layer"] = layer.name
+        return self
+
+
 def memory(name, size, boot_layer=None, boot_value=0.0):
     """Inside a recurrent_group step: the value the step-layer `name` had
-    at t-1 (boot at t=0). Mirrors trainer_config_helpers memory()."""
+    at t-1 (boot at t=0). Mirrors trainer_config_helpers memory().
+    `name=None` defers the producing-layer binding to a later
+    `.set_input(layer)` call on the returned ref."""
     g = current()
-    link = f"@mem_{name}"
+    link = f"@mem_{name}" if name is not None else g.uniq("@mem_anon")
     g.add(
         LayerConf(
             name=link, type="data", size=size,
             attrs={"dim": (size,), "is_seq": False, "is_ids": False},
         )
     )
-    g.memories.append(
-        {
-            "layer": name,
-            "link": link,
-            "boot_layer": boot_layer.name if boot_layer is not None else None,
-            "boot_value": boot_value,
-            "size": size,
-        }
-    )
-    return LayerRef(link, g)
+    record = {
+        "layer": name,
+        "link": link,
+        "boot_layer": boot_layer.name if boot_layer is not None else None,
+        "boot_value": boot_value,
+        "size": size,
+    }
+    g.memories.append(record)
+    return MemoryRef(link, g, record)
 
 
 def recurrent_group(step, inputs, name=None, reversed=False):

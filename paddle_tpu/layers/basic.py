@@ -59,12 +59,19 @@ class FCLayer(Layer):
     def forward(self, params, inputs, ctx):
         y = None
         seq_lens = None
+        any_seq = any(a.is_seq for a in inputs)
         for i, arg in enumerate(inputs):
             x = arg.value
             if arg.is_seq:
                 seq_lens = arg.seq_lens
             x = x.reshape(x.shape[: 2 if arg.is_seq else 1] + (-1,))
             t = jnp.dot(x, params[f"w{i}"])
+            if any_seq and not arg.is_seq:
+                # mixed seq + non-seq inputs: broadcast the per-example
+                # term over the time axis (a sequence-level memory
+                # feeding a per-timestep fc — the reference
+                # test_rnn_group subsequence-group pattern)
+                t = t[:, None, :]
             y = t if y is None else y + t
         if "b" in params:
             y = y + params["b"]

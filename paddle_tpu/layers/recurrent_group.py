@@ -313,7 +313,16 @@ class RecurrentGroupLayer(Layer):
             )
             new_carry = {}
             for m in self.memories:
-                new_v = outs[m["layer"]].value
+                src = outs[m["layer"]]
+                new_v = src.value
+                if new_v.ndim == carry[m["layer"]].ndim + 1:
+                    # the memory source produced a SEQUENCE this outer
+                    # step (per-timestep layer inside the subsequence
+                    # walk): carry its last VALID frame — the
+                    # sequence-level memory of the reference's
+                    # subsequence-group pattern (test_rnn_group)
+                    last = jnp.maximum(lens_s - 1, 0)
+                    new_v = jax.vmap(lambda xb, j: xb[j])(new_v, last)
                 prev = carry[m["layer"]]
                 new_carry[m["layer"]] = (
                     m_s * new_v + (1.0 - m_s) * prev

