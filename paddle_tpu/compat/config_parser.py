@@ -243,6 +243,9 @@ ExtraAttr = ExtraLayerAttribute
 class _Pooling:
     name = ""
 
+    def __init__(self, output_max_index=False, **_):
+        self.output_max_index = output_max_index
+
 
 class MaxPooling(_Pooling):
     name = "max"

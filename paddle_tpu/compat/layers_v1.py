@@ -115,6 +115,22 @@ __all__ = [
     "small_vgg",
     "vgg_16_network",
     "sub_nested_seq_layer",
+    "warp_ctc_layer",
+    "lambda_cost",
+    "huber_cost",
+    "cross_entropy_with_selfnorm",
+    "smooth_l1_cost",
+    "print_layer",
+    "pad_layer",
+    "crop_layer",
+    "trans_layer",
+    "row_l2_norm_layer",
+    "sum_to_one_norm_layer",
+    "conv_operator",
+    "conv_projection",
+    "AggregateLevel",
+    "ExpandLevel",
+    "IdentityActivation",
     "get_output_layer",
     "memory",
     "StaticInput",
@@ -159,6 +175,33 @@ SoftReluActivation = _make_act("SoftReluActivation", "softrelu")
 AbsActivation = _make_act("AbsActivation", "abs")
 SquareActivation = _make_act("SquareActivation", "square")
 ExpActivation = _make_act("ExpActivation", "exponential")
+IdentityActivation = LinearActivation  # reference alias
+
+
+class AggregateLevel:
+    """(layers.py:253) TO_NO_SEQUENCE aggregates a (nested) sequence to
+    one vector; TO_SEQUENCE aggregates each SUB-sequence to one
+    timestep. String values match the reference proto ('non-seq' /
+    'seq'); legacy aliases kept."""
+
+    TO_NO_SEQUENCE = "non-seq"
+    TO_SEQUENCE = "seq"
+    EACH_TIMESTEP = TO_NO_SEQUENCE
+    EACH_SEQUENCE = TO_SEQUENCE
+
+
+class ExpandLevel:
+    """(layers.py:1709)."""
+
+    FROM_NO_SEQUENCE = AggregateLevel.TO_NO_SEQUENCE
+    FROM_SEQUENCE = AggregateLevel.TO_SEQUENCE
+    FROM_TIMESTEP = FROM_NO_SEQUENCE
+
+
+def _agg_to_level(agg_level):
+    """Map the v1 AggregateLevel to the internal level attr: TO_SEQUENCE
+    ('seq') acts per SUB-sequence -> internal 'subseq'."""
+    return "subseq" if agg_level == AggregateLevel.TO_SEQUENCE else "seq"
 
 
 def _act(a) -> str:
@@ -308,7 +351,13 @@ def addto_layer(input, act=None, name=None, bias_attr=False, **_):
 
 
 def concat_layer(input, name=None, **_):
-    return dsl.concat(*_many(input), name=name)
+    # v1 concat also accepts PROJECTIONS as inputs (layers.py
+    # concat_layer); materialize each as a one-term sizeless mixed
+    ins = [
+        dsl.mixed(0, [x], bias=False) if isinstance(x, tuple) else x
+        for x in _many(input)
+    ]
+    return dsl.concat(*ins, name=name)
 
 
 def dropout_layer(input, dropout_rate, name=None, **_):
@@ -416,22 +465,44 @@ def grumemory(input, size=None, act=None, gate_act=None, reverse=False,
     )
 
 
-def pooling_layer(input, pooling_type=None, name=None, **_):
+def pooling_layer(input, pooling_type=None, name=None,
+                  agg_level=AggregateLevel.TO_NO_SEQUENCE, stride=-1,
+                  **_):
     # v1 default is MaxPooling (trainer_config_helpers pooling_layer)
+    extra = {}
+    if getattr(pooling_type, "output_max_index", False):
+        assert not (stride and stride > 0), (
+            "pooling_layer: output_max_index with stride is not "
+            "supported (ambiguous output shape)"
+        )
+        extra["output_max_index"] = True
+    if stride and stride > 0:
+        extra["stride"] = stride
     return dsl.seq_pool(_one(input), pool_type=_pool_type(pooling_type),
-                        name=name)
+                        level=_agg_to_level(agg_level), name=name,
+                        **extra)
 
 
-def last_seq(input, name=None, **_):
-    return dsl.last_seq(_one(input), name=name)
+def last_seq(input, name=None,
+             agg_level=AggregateLevel.TO_NO_SEQUENCE, stride=-1, **_):
+    return dsl.last_seq(_one(input), name=name,
+                        stride=max(stride, 0),
+                        level=_agg_to_level(agg_level))
 
 
-def first_seq(input, name=None, **_):
-    return dsl.first_seq(_one(input), name=name)
+def first_seq(input, name=None,
+              agg_level=AggregateLevel.TO_NO_SEQUENCE, stride=-1, **_):
+    return dsl.first_seq(_one(input), name=name,
+                         stride=max(stride, 0),
+                         level=_agg_to_level(agg_level))
 
 
-def expand_layer(input, expand_as, name=None, **_):
-    return dsl.expand(_one(input), expand_as, name=name)
+def expand_layer(input, expand_as, name=None,
+                 expand_level=ExpandLevel.FROM_NO_SEQUENCE, **_):
+    return dsl.expand(_one(input), expand_as, name=name,
+                      level=("seq"
+                             if expand_level == ExpandLevel.FROM_SEQUENCE
+                             else "non-seq"))
 
 
 def seq_concat_layer(a, b, name=None, **_):
@@ -598,8 +669,8 @@ def tensor_layer(a, b, size, act=None, name=None, bias_attr=True, **_):
                     act=_act(act), bias=bool(bias_attr))
 
 
-def cos_sim(a, b, scale=1.0, name=None, **_):
-    return dsl.cos_sim(a, b, scale=scale, name=name)
+def cos_sim(a, b, scale=1.0, size=1, name=None, **_):
+    return dsl.cos_sim(a, b, scale=scale, size=size, name=name)
 
 
 def scaling_layer(input, weight, name=None, **_):
@@ -615,7 +686,9 @@ def interpolation_layer(input, weight, name=None, **_):
     return dsl.interpolation(weight, a, b, name=name)
 
 
-def linear_comb_layer(weights, vectors, size, name=None, **_):
+def linear_comb_layer(weights, vectors, size=None, name=None, **_):
+    # v1 infers size = vectors.size / weights.size when omitted
+    size = size or _layer_size(vectors) // max(_layer_size(weights), 1)
     return dsl.linear_comb(weights, vectors, size, name=name)
 
 
@@ -660,20 +733,24 @@ def multiplex_layer(input, name=None, **_):
     return dsl._add("multiplex", _many(input), name=name, bias=False)
 
 
-def nce_layer(input, label, num_classes, num_neg_samples=10, name=None,
-              param_attr=None, bias_attr=True, neg_distribution=None,
-              **_):
+def nce_layer(input, label, num_classes=None, num_neg_samples=10,
+              name=None, param_attr=None, bias_attr=True,
+              neg_distribution=None, weight=None, **_):
+    # v1 derives num_classes from the label layer's width when omitted
+    num_classes = num_classes or _layer_size(label)
     return dsl._add("nce", [*_many(input), label], name=name,
                     size=num_classes, bias=bool(bias_attr),
-                    param=param_attr, num_neg_samples=num_neg_samples,
+                    param=param_attr, num_classes=num_classes,
+                    num_neg_samples=num_neg_samples,
                     neg_distribution=neg_distribution)
 
 
-def hsigmoid(input, label, num_classes, name=None, param_attr=None,
+def hsigmoid(input, label, num_classes=None, name=None, param_attr=None,
              bias_attr=True, **_):
+    num_classes = num_classes or _layer_size(label)
     return dsl._add("hsigmoid", [*_many(input), label], name=name,
                     size=num_classes, bias=bool(bias_attr),
-                    param=param_attr)
+                    param=param_attr, num_classes=num_classes)
 
 
 def crf_layer(input, label, size=None, param_attr=None, name=None, **_):
@@ -690,8 +767,9 @@ def crf_decoding_layer(input, size, label=None, param_attr=None,
                             name=name, param=param_attr)
 
 
-def ctc_layer(input, label, size, blank=0, norm_by_times=False,
+def ctc_layer(input, label, size=None, blank=0, norm_by_times=False,
               name=None, **_):
+    size = size or _layer_size(_one(input))
     # v1 CTC consumes an already-softmaxed input (the config applies
     # SoftmaxActivation on the fc) — do NOT softmax again. name=None
     # auto-uniquifies (a fixed "cost" would collide across layers).
@@ -704,6 +782,119 @@ def eos_layer(input, eos_id, name=None, **_):
     return dsl.eos_id(_one(input), eos_id, name=name)
 
 
+def warp_ctc_layer(input, label, size=None, blank=0,
+                   norm_by_times=False, name=None, **_):
+    """(layers.py warp_ctc_layer) — same lowering as ctc_layer; the
+    warp-ctc/builtin split is a GPU-kernel distinction with no XLA
+    analogue."""
+    size = size or _layer_size(_one(input))
+    # unlike ctc_layer, the warp-ctc contract integrates the softmax:
+    # the config feeds LINEAR logits (reference layers.py
+    # warp_ctc_layer doc), so the layer applies it
+    return dsl._add("warp_ctc", [input, label], name=name, size=size,
+                    bias=False, blank=blank,
+                    norm_by_times=norm_by_times, apply_softmax=True)
+
+
+def lambda_cost(input, score, NDCG_num=5, max_sort_size=-1, name=None,
+                **_):
+    """(layers.py lambda_cost; CostLayer.cpp LambdaCost)."""
+    return dsl._add("lambda_cost", [_one(input), _one(score)],
+                    name=name, bias=False, NDCG_num=NDCG_num,
+                    max_sort_size=max_sort_size)
+
+
+def huber_cost(input, label, name=None, coeff=1.0, **_):
+    """(layers.py huber_cost — two-class Huber classification)."""
+    return dsl._add("huber_classification", [_one(input), _one(label)],
+                    name=name, bias=False, coeff=coeff)
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1, **_):
+    return dsl._add(
+        "multi_class_cross_entropy_with_selfnorm",
+        [_one(input), _one(label)], name=name, bias=False, coeff=coeff,
+        softmax_selfnorm_alpha=softmax_selfnorm_alpha,
+    )
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, **_):
+    return dsl._add("smooth_l1", [_one(input), _one(label)],
+                    name=name, bias=False, coeff=coeff)
+
+
+def print_layer(input, format=None, name=None, **_):
+    """(layers.py print_layer; PrintLayer.cpp) — identity that prints
+    during execution (jax.debug.print under jit)."""
+    for x in _many(input):
+        dsl._add("print", [x], name=name, bias=False)
+    # the reference returns None (print is a side effect)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+              **_):
+    return dsl._add("pad", [_one(input)], name=name, bias=False,
+                    pad_c=tuple(pad_c or (0, 0)),
+                    pad_h=tuple(pad_h or (0, 0)),
+                    pad_w=tuple(pad_w or (0, 0)))
+
+
+def crop_layer(input, offset=None, axis=2, shape=None, name=None, **_):
+    """(layers.py crop_layer) — crop input[0] to input[1]'s spatial
+    shape (or explicit offset/shape)."""
+    ins = _many(input)
+    attrs = {}
+    if offset is not None and shape is not None:
+        attrs = {"crop_h": (offset[0], shape[0]),
+                 "crop_w": (offset[1], shape[1])}
+    return dsl._add("crop", ins, name=name, bias=False, **attrs)
+
+
+def trans_layer(input, name=None, **_):
+    # height/width resolve at build: the layer reads the input spec's
+    # (H, W) dims when present, else infers a square from the width
+    return dsl._add("trans", [_one(input)], name=name, bias=False)
+
+
+def row_l2_norm_layer(input, name=None, **_):
+    return dsl._add("row_l2_norm", [_one(input)], name=name, bias=False)
+
+
+def sum_to_one_norm_layer(input, name=None, **_):
+    return dsl._add("sum_to_one_norm", [_one(input)], name=name,
+                    bias=False)
+
+
+def conv_operator(img, filter, filter_size, num_filters,
+                  num_channels=1, stride=1, padding=0, trans=False,
+                  filter_size_y=None, stride_y=None, padding_y=None,
+                  **_):
+    """(layers.py conv_operator) — a mixed-layer term whose FILTER
+    comes from the graph (per-example dynamic filters); materializes
+    the conv_operator layer and feeds it back through an identity
+    projection, like dotmul_operator."""
+    ref = dsl._add(
+        "conv_operator", [_one(img), _one(filter)], bias=False,
+        num_filters=num_filters, num_channels=num_channels,
+        filter_size=filter_size, stride=stride, padding=padding,
+        trans=bool(trans),
+    )
+    return (ref, "identity")
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=1,
+                    stride=1, padding=0, trans=False, param_attr=None,
+                    **_):
+    """(layers.py conv_projection) — learned-weight conv as a mixed
+    term; materializes a conv (or conv-transpose) layer."""
+    f = dsl.conv_trans if trans else dsl.conv
+    ref = f(_one(input), num_filters, filter_size, stride=stride,
+            padding=padding, act="", param=param_attr,
+            num_channels=num_channels)
+    return (ref, "identity")
+
+
 def priorbox_layer(input, image, min_size, max_size=(), aspect_ratio=(),
                    variance=(0.1, 0.1, 0.2, 0.2), name=None, **_):
     return dsl.priorbox(_one(input), image, min_size, max_size,
@@ -714,15 +905,26 @@ def multibox_loss_layer(input_loc, input_conf, priorbox, label,
                         num_classes, overlap_threshold=0.5,
                         neg_pos_ratio=3.0, neg_overlap=0.5, name=None,
                         **kw):
-    """DIVERGENCE from v1: ground truth arrives as TWO layers — `label`
-    must be the [B,G,4] box data layer and `gt_label=` the [B,G] class
-    id layer (v1 packed both into one record stream, which a
-    static-shape feed cannot express)."""
+    """Two forms: pass `gt_label=` (class-id layer) with `label` the
+    [B,G,4] boxes (the explicit two-feed form), or the reference's
+    single PACKED label layer (per box [label, x1, y1, x2, y2,
+    difficult] — width a multiple of 6), which the layer splits on
+    device (`packed_label` attr)."""
     gt_label = kw.get("gt_label")
-    assert gt_label is not None, (
-        "multibox_loss_layer: pass gt_label= (class-id data layer); "
-        "see docstring — boxes and labels are separate feeds here"
-    )
+    if gt_label is None:
+        if isinstance(input_loc, (list, tuple)):
+            input_loc = dsl.concat(*input_loc)
+        if isinstance(input_conf, (list, tuple)):
+            input_conf = dsl.concat(*input_conf)
+        return dsl._add(
+            "multibox_loss",
+            [priorbox, label, label, input_loc, input_conf],
+            name=name, bias=False, num_classes=num_classes,
+            overlap_threshold=overlap_threshold,
+            neg_pos_ratio=neg_pos_ratio, neg_overlap=neg_overlap,
+            background_id=kw.get("background_id", 0),
+            packed_label=True,
+        )
     return dsl.multibox_loss(priorbox, label, gt_label, input_loc,
                              input_conf, num_classes, name=name,
                              overlap_threshold=overlap_threshold,
@@ -775,7 +977,8 @@ def _effective_act(conf, name, depth=8):
     return ""
 
 
-def classification_cost(input, label, name=None, coeff=1.0, **_):
+def classification_cost(input, label, name=None, coeff=1.0,
+                        weight=None, **_):
     """Reference classification_cost = multi-class CE on the input
     DISTRIBUTION (the v1 idiom puts act=Softmax on the input fc;
     CostLayer.cpp MultiClassCrossEntropy reads probabilities). Route a
@@ -785,8 +988,10 @@ def classification_cost(input, label, name=None, coeff=1.0, **_):
     softmax+CE composite (same math the reference composes)."""
     x = _one(input)
     if _effective_act(x.builder.conf, x.name) == "softmax":
-        return dsl.cross_entropy(x, label, name=name, coeff=coeff)
-    return dsl.classification_cost(x, label, name=name, coeff=coeff)
+        return dsl.cross_entropy(x, label, name=name, coeff=coeff,
+                                 weight=weight)
+    return dsl.classification_cost(x, label, name=name, coeff=coeff,
+                                   weight=weight)
 
 
 def cross_entropy(input, label, name=None, coeff=1.0, **_):

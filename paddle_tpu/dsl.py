@@ -66,6 +66,14 @@ _stack: list = []
 
 # layer types whose output width equals input `idx`'s width — stamped
 # onto LayerConf.size at DSL time (see _add)
+# layer types whose LayerConf.size is NOT the flat output width at
+# DSL time (it holds num_filters; spatial dims resolve at build)
+_SIZE_AT_BUILD_ONLY = {
+    "exconv", "exconvt", "conv", "cudnn_conv", "conv_operator",
+    "pool", "spp", "maxout", "blockexpand", "fused_conv1x1_bn",
+    "fused_bottleneck_tail",
+}
+
 _SIZE_PRESERVING = {
     "addto": 0,
     "slope_intercept": 0,
@@ -175,8 +183,10 @@ def concat(*inputs, name=None):
     return _add("concat", inputs, name=name)
 
 
-def cos_sim(a, b, scale=1.0, name=None):
-    return _add("cos", [a, b], name=name, scale=scale)
+def cos_sim(a, b, scale=1.0, size=1, name=None):
+    """size=k > 1: b packs k vectors of a's width; output [B, k]
+    similarities (layers.py cos_sim size param)."""
+    return _add("cos", [a, b], name=name, size=size, scale=scale)
 
 
 def scaling(weight, x, name=None):
@@ -213,11 +223,17 @@ def mixed(size, inputs, name=None, act="", bias=True):
         g = current()
         for ic in ins:
             try:
-                in_size = g.conf.layer(ic.name).size
+                src_lc = g.conf.layer(ic.name)
             except KeyError:
                 continue
+            if src_lc.type in _SIZE_AT_BUILD_ONLY:
+                # conv/pool-family LayerConf.size holds num_filters,
+                # not the flat width — only their build() knows the
+                # real size; leave 0 for MixedLayer.build to resolve
+                continue
             inferred = mixed_proj_size(
-                ic.attrs.get("proj", "full_matrix"), in_size, ic.attrs
+                ic.attrs.get("proj", "full_matrix"), src_lc.size,
+                ic.attrs
             )
             if inferred:
                 size = inferred
@@ -290,11 +306,13 @@ def fused_bottleneck_tail(x, num_filters, residual=None, act="relu",
 
 
 def conv_trans(x, num_filters, filter_size, stride=1, padding=0, name=None,
-               act="relu", bias=True, param=None, bias_param=None):
+               act="relu", bias=True, param=None, bias_param=None,
+               num_channels=None):
+    kw = {"num_channels": num_channels} if num_channels else {}
     return _add("exconvt", [x], name=name, size=num_filters, act=act,
                 bias=bias, param=param, bias_param=bias_param,
                 num_filters=num_filters, filter_size=filter_size,
-                stride=stride, padding=padding)
+                stride=stride, padding=padding, **kw)
 
 
 def pool(x, pool_size, stride=None, padding=0, pool_type="max", name=None):
@@ -520,20 +538,35 @@ def img_conv_bn_pool(x, filter_size, num_filters, pool_size, name=None,
 
 # ---- sequence structure ----
 
-def seq_pool(x, pool_type="sum", level="seq", name=None):
-    return _add("seqpool", [x], name=name, pool_type=pool_type, level=level)
+def seq_pool(x, pool_type="sum", level="seq", name=None, stride=0,
+             output_max_index=False):
+    """stride>0 pools each stride-window to one frame (output stays a
+    sequence); output_max_index with max pooling emits the argmax
+    timestep per feature instead of the value (both from
+    SequencePoolLayer.cpp / MaxLayer.cpp)."""
+    return _add("seqpool", [x], name=name, pool_type=pool_type,
+                level=level, stride=stride,
+                output_max_index=output_max_index)
 
 
-def last_seq(x, name=None):
-    return _add("seqlastins", [x], name=name)
+def last_seq(x, name=None, stride=0, level="seq"):
+    """level="subseq": one frame per subsequence of a nested input
+    (AggregateLevel.TO_SEQUENCE); stride>0: one frame per
+    stride-window (both from SequenceLastInstanceLayer.cpp)."""
+    return _add("seqlastins", [x], name=name, stride=stride,
+                level=level)
 
 
-def first_seq(x, name=None):
-    return _add("seqlastins", [x], name=name, select_first=True)
+def first_seq(x, name=None, stride=0, level="seq"):
+    return _add("seqlastins", [x], name=name, select_first=True,
+                stride=stride, level=level)
 
 
-def expand(x, ref, name=None):
-    return _add("expand", [x, ref], name=name)
+def expand(x, ref, name=None, level="non-seq"):
+    """level="seq" (ExpandLevel.FROM_SEQUENCE): x is a sequence with
+    one frame per SUB-sequence of the nested ref; each frame repeats
+    over its subsequence's timesteps."""
+    return _add("expand", [x, ref], name=name, expand_level=level)
 
 
 def seq_concat(a, b, name=None):
@@ -678,19 +711,23 @@ def recurrent_group(step, inputs, name=None, reversed=False):
 
 # ---- costs ----
 
-def classification_cost(logits, label, name=None, coeff=1.0):
-    return _add("classification_cost", [logits, label], name=name or _cost_name(),
+def classification_cost(logits, label, name=None, coeff=1.0,
+                        weight=None):
+    ins = [logits, label] + ([weight] if weight is not None else [])
+    return _add("classification_cost", ins, name=name or _cost_name(),
                 bias=False, coeff=coeff)
 
 
-def cross_entropy(prob, label, name=None, coeff=1.0):
-    return _add("multi-class-cross-entropy", [prob, label],
+def cross_entropy(prob, label, name=None, coeff=1.0, weight=None):
+    ins = [prob, label] + ([weight] if weight is not None else [])
+    return _add("multi-class-cross-entropy", ins,
                 name=name or _cost_name(), bias=False, coeff=coeff)
 
 
-def square_error(x, y, name=None, coeff=1.0):
-    return _add("square_error", [x, y], name=name or _cost_name(), bias=False,
-                coeff=coeff)
+def square_error(x, y, name=None, coeff=1.0, weight=None):
+    ins = [x, y] + ([weight] if weight is not None else [])
+    return _add("square_error", ins, name=name or _cost_name(),
+                bias=False, coeff=coeff)
 
 
 def rank_cost(a, b, label, name=None, coeff=1.0):

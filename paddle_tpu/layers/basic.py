@@ -171,16 +171,33 @@ class ConcatLayer(Layer):
 @LAYERS.register("cos")
 class CosSimLayer(Layer):
     """Cosine similarity of two inputs, scaled (gserver/layers/CosSimLayer.cpp,
-    function/CosSimOp.cpp). attrs: scale (default 1)."""
+    function/CosSimOp.cpp). attrs: scale (default 1). With size=k > 1,
+    input b packs k vectors of a's width and the output is the k
+    similarities per example (the reference's multi-vector form,
+    cos_sim(size=k))."""
 
     def build(self, in_specs):
         seq = any(s.is_seq for s in in_specs)
-        return Spec(dim=(1,), is_seq=seq), {}
+        k = self.conf.size or 1
+        if k > 1:
+            assert in_specs[1].size == k * in_specs[0].size, (
+                f"cos {self.name}: size={k} needs b of width "
+                f"{k}*{in_specs[0].size}, got {in_specs[1].size}"
+            )
+        return Spec(dim=(k,), is_seq=seq), {}
 
     def forward(self, params, inputs, ctx):
         a, b = inputs[0].value, inputs[1].value
         scale = self.conf.attrs.get("scale", 1.0)
+        k = self.conf.size or 1
         eps = 1e-8
+        if k > 1:
+            b = b.reshape(b.shape[:-1] + (k, a.shape[-1]))
+            a = a[..., None, :]
+            num = jnp.sum(a * b, axis=-1)
+            den = jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+            return Arg(value=scale * num / jnp.maximum(den, eps),
+                       seq_lens=inputs[0].seq_lens)
         num = jnp.sum(a * b, axis=-1, keepdims=True)
         den = jnp.linalg.norm(a, axis=-1, keepdims=True) * jnp.linalg.norm(
             b, axis=-1, keepdims=True
@@ -397,11 +414,27 @@ class TransLayer(Layer):
     attrs: height, width."""
 
     def build(self, in_specs):
-        h, w = self.conf.attrs["height"], self.conf.attrs["width"]
-        return Spec(dim=(w * h,), is_seq=in_specs[0].is_seq), {}
+        (s,) = in_specs
+        a = self.conf.attrs
+        h, w = a.get("height"), a.get("width")
+        if not (h and w):
+            if len(s.dim) >= 2:
+                # per-example [H, W(, C=1)] view from the input spec
+                h, w = s.dim[0], s.dim[1] * (
+                    s.dim[2] if len(s.dim) == 3 else 1
+                )
+            else:
+                hw = int(round(s.size ** 0.5))
+                assert hw * hw == s.size, (
+                    f"trans {self.name}: flat width {s.size} is not "
+                    "square; pass height/width"
+                )
+                h = w = hw
+        self._hw = (h, w)
+        return Spec(dim=(w * h,), is_seq=s.is_seq), {}
 
     def forward(self, params, inputs, ctx):
-        h, w = self.conf.attrs["height"], self.conf.attrs["width"]
+        h, w = self._hw
         x = inputs[0].value
         lead = x.shape[:-1]
         y = x.reshape(lead + (h, w)).swapaxes(-1, -2).reshape(lead + (h * w,))

@@ -159,3 +159,63 @@ class ConvTransLayer(Layer):
             y = y + params["b"]
         y = self.apply_activation_and_dropout(y, ctx, arg.seq_lens)
         return Arg(value=y, seq_lens=arg.seq_lens)
+
+
+@LAYERS.register("conv_operator")
+class ConvOperatorLayer(Layer):
+    """Dynamic-filter 2-D conv (trainer_config_helpers conv_operator;
+    gserver ConvOperator.cpp as a mixed-layer term): inputs
+    [img, filter] where the FILTER VALUES are a graph output
+    [B, fh*fw*C*NF] — each example is convolved with its own filter;
+    the operator has no learned parameters of its own. attrs:
+    num_filters, num_channels, filter_size, stride, padding, trans
+    (conv_transpose)."""
+
+    def build(self, in_specs):
+        s = in_specs[0]
+        a = self.conf.attrs
+        h, w, c = _image_shape(self.conf.name, s, a)
+        fh, fw = _pair(a.get("filter_size", 3))
+        sh, sw = _pair(a.get("stride", 1))
+        ph, pw = _pair(a.get("padding", 0))
+        nf = a["num_filters"]
+        exp = fh * fw * c * nf
+        assert in_specs[1].size == exp, (
+            f"conv_operator {self.name}: filter input is "
+            f"{in_specs[1].size} wide, need fh*fw*C*NF = {exp}"
+        )
+        if a.get("trans"):
+            oh = (h - 1) * sh - 2 * ph + fh
+            ow = (w - 1) * sw - 2 * pw + fw
+        else:
+            oh = conv_out_size(h, fh, sh, ph)
+            ow = conv_out_size(w, fw, sw, pw)
+        self._shape = (h, w, c)
+        return Spec(dim=(oh, ow, nf)), {}
+
+    def forward(self, params, inputs, ctx):
+        import jax
+        from jax import lax
+
+        img, filt = inputs
+        a = self.conf.attrs
+        fh, fw = _pair(a.get("filter_size", 3))
+        sh, sw = _pair(a.get("stride", 1))
+        ph, pw = _pair(a.get("padding", 0))
+        nf = a["num_filters"]
+        h, w, c = self._shape
+        x = img.value.reshape(-1, h, w, c)
+        f = filt.value.reshape(-1, fh, fw, c, nf)
+        dn = ("NHWC", "HWIO", "NHWC")
+        pad = [(ph, ph), (pw, pw)]
+
+        def one(xb, fb):
+            if a.get("trans"):
+                return lax.conv_transpose(
+                    xb[None], fb, (sh, sw), pad, dimension_numbers=dn
+                )[0]
+            return lax.conv_general_dilated(
+                xb[None], fb, (sh, sw), pad, dimension_numbers=dn
+            )[0]
+
+        return Arg(value=jax.vmap(one)(x, f))

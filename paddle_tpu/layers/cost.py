@@ -40,6 +40,15 @@ class CostLayerBase(Layer):
             per_token = jnp.sum(per_token, axis=1)
         return Arg(value=w * per_token)
 
+    def _weighted(self, cost_arg: Arg, rest) -> Arg:
+        """Optional per-example weight input (the v1 weight= kwarg on
+        classification_cost/mse_cost; CostLayer.cpp weightLayer_):
+        multiplies each example's cost."""
+        if not rest:
+            return cost_arg
+        w = rest[0].value.reshape(cost_arg.value.shape[0])
+        return Arg(value=cost_arg.value * w)
+
 
 @LAYERS.register("multi-class-cross-entropy", "cross_entropy")
 class MultiClassCrossEntropy(CostLayerBase):
@@ -47,11 +56,13 @@ class MultiClassCrossEntropy(CostLayerBase):
     layer). inputs: [prob, label(ids)]."""
 
     def forward(self, params, inputs, ctx):
-        prob, label = inputs
+        prob, label, *rest = inputs
         p = jnp.take_along_axis(
             prob.value, label.ids[..., None], axis=-1
         )[..., 0]
-        return self._reduce(-jnp.log(jnp.maximum(p, _EPS)), prob)
+        return self._weighted(
+            self._reduce(-jnp.log(jnp.maximum(p, _EPS)), prob), rest
+        )
 
 
 @LAYERS.register("classification_cost", "softmax_with_cross_entropy")
@@ -61,12 +72,12 @@ class SoftmaxCrossEntropy(CostLayerBase):
     for TPU (one logsumexp, no materialized probs)."""
 
     def forward(self, params, inputs, ctx):
-        logits, label = inputs
+        logits, label, *rest = inputs
         lse = jax.scipy.special.logsumexp(logits.value, axis=-1)
         picked = jnp.take_along_axis(
             logits.value, label.ids[..., None], axis=-1
         )[..., 0]
-        return self._reduce(lse - picked, logits)
+        return self._weighted(self._reduce(lse - picked, logits), rest)
 
 
 @LAYERS.register("square_error", "sum_of_squares", "mse")
@@ -74,9 +85,11 @@ class SumOfSquaresCost(CostLayerBase):
     """0.5*||x - y||^2 per example (CostLayer.cpp SumOfSquaresCostLayer)."""
 
     def forward(self, params, inputs, ctx):
-        x, y = inputs
+        x, y, *rest = inputs
         d = x.value - y.value
-        return self._reduce(0.5 * jnp.sum(jnp.square(d), axis=-1), x)
+        return self._weighted(
+            self._reduce(0.5 * jnp.sum(jnp.square(d), axis=-1), x), rest
+        )
 
 
 @LAYERS.register("smooth_l1")

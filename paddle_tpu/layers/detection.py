@@ -69,6 +69,18 @@ class MultiBoxLossLayer(CostLayerBase):
     def forward(self, params, inputs, ctx):
         prior, gt_box, gt_label, loc, conf = inputs
         a = self.conf.attrs
+        if a.get("packed_label"):
+            # the v1 packed ground-truth record: per box
+            # [label, x1, y1, x2, y2, difficult]; split on device
+            packed = gt_box.value.reshape(
+                gt_box.value.shape[0], -1, 6
+            )
+            gt_label = Arg(
+                ids=packed[..., 0].astype(jnp.int32),
+                seq_lens=gt_box.seq_lens,
+            )
+            gt_box = Arg(value=packed[..., 1:5],
+                         seq_lens=gt_box.seq_lens)
         C = a["num_classes"]
         priors, variances = _split_priors(prior)
         P = priors.shape[0]

@@ -33,8 +33,16 @@ class SequencePoolLayer(Layer):
     def build(self, in_specs):
         (s,) = in_specs
         level = self.conf.attrs.get("level", "seq")
+        assert not (
+            self.conf.attrs.get("stride", 0)
+            and self.conf.attrs.get("output_max_index")
+        ), f"seqpool {self.name}: stride with output_max_index is ambiguous"
+        if self.conf.attrs.get("stride", 0):
+            return Spec(dim=s.dim, is_seq=True), {}
         if level == "subseq":
-            assert s.has_subseq
+            # non-nested input: each whole sequence acts as its ONE
+            # subsequence (upstream configs apply TO_SEQUENCE to plain
+            # sequences; parse accepts it there)
             return Spec(dim=s.dim, is_seq=True), {}
         return Spec(dim=s.dim), {}
 
@@ -49,6 +57,43 @@ class SequencePoolLayer(Layer):
         )
         kind = self.conf.attrs.get("pool_type", default)
         level = self.conf.attrs.get("level", "seq")
+        stride = self.conf.attrs.get("stride", 0) or 0
+        if self.conf.attrs.get("output_max_index"):
+            # max-pool-with-index (MaxLayer.cpp output_max_index): the
+            # argmax TIMESTEP per feature, as values
+            x = arg.value
+            t = x.shape[1]
+            mask = jnp.arange(t)[None, :, None] < arg.seq_lens[:, None, None]
+            idx = jnp.argmax(
+                jnp.where(mask, x, -jnp.inf), axis=1
+            ).astype(x.dtype)
+            return Arg(value=idx)
+        if stride > 0:
+            # one pooled frame per stride-window (strided sequence
+            # pooling, SequencePoolLayer.cpp stride_): output a
+            # sequence of ceil(len/stride) frames
+            x = arg.value
+            b, t = x.shape[0], x.shape[1]
+            n_w = -(-t // stride)
+            pad_t = n_w * stride - t
+            xw = jnp.pad(x, ((0, 0), (0, pad_t)) + ((0, 0),) * (x.ndim - 2))
+            xw = xw.reshape(b, n_w, stride, *x.shape[2:])
+            pos = (jnp.arange(n_w * stride).reshape(n_w, stride))[None]
+            m = (pos < arg.seq_lens[:, None, None]).astype(x.dtype)
+            m = m.reshape(b, n_w, stride, *([1] * (x.ndim - 2)))
+            if kind in ("sum", "average", "avg", "sqrt_average"):
+                s = jnp.sum(xw * m, axis=2)
+                if kind in ("average", "avg"):
+                    s = s / jnp.maximum(m.sum(axis=2), 1.0)
+                elif kind == "sqrt_average":
+                    s = s / jnp.sqrt(jnp.maximum(m.sum(axis=2), 1.0))
+                y = s
+            else:  # max
+                neg = jnp.where(m > 0, xw, -jnp.inf)
+                y = jnp.max(neg, axis=2)
+                y = jnp.where(jnp.isfinite(y), y, 0.0)
+            out_lens = -(-arg.seq_lens // stride)
+            return Arg(value=y, seq_lens=out_lens.astype(jnp.int32))
         if level == "subseq":
             op_map = {
                 "sum": "sum", "average": "avg", "avg": "avg", "max": "max",
@@ -59,6 +104,12 @@ class SequencePoolLayer(Layer):
                     f"seqpool {self.name}: pool_type {kind!r} not supported at "
                     f"subseq level (supported: {sorted(op_map)})"
                 )
+            if arg.subseq_lens is None:
+                # plain sequence under TO_SEQUENCE: the whole sequence
+                # is its one subsequence -> [B, 1, D]
+                y = self._OPS[kind](arg.value, arg.seq_lens)[:, None]
+                ones = jnp.ones((y.shape[0],), jnp.int32)
+                return Arg(value=y, seq_lens=ones)
             y = sops.subseq_pool(arg.value, arg.subseq_lens, op_map[kind])
             lens = jnp.sum((arg.subseq_lens > 0).astype(jnp.int32), axis=1)
             return Arg(value=y, seq_lens=lens)
@@ -69,33 +120,95 @@ class SequencePoolLayer(Layer):
 @LAYERS.register("seqlastins", "last_seq")
 class SequenceLastInstanceLayer(Layer):
     """Last (or first) real timestep (SequenceLastInstanceLayer.cpp).
-    attrs: select_first."""
+    attrs: select_first; stride (>0: one frame per stride-window, the
+    reference's strided selection — output stays a sequence); level
+    ("seq" whole-sequence default; "subseq": one frame per
+    SUB-sequence of a nested input, output a plain sequence —
+    AggregateLevel.TO_SEQUENCE)."""
 
     def build(self, in_specs):
         (s,) = in_specs
-        return Spec(dim=s.dim), {}
+        stride = self.conf.attrs.get("stride", 0) or 0
+        level = self.conf.attrs.get("level", "seq")
+        is_seq = stride > 0 or (level == "subseq" and s.has_subseq)
+        return Spec(dim=s.dim, is_seq=is_seq), {}
 
     def forward(self, params, inputs, ctx):
         (arg,) = inputs
-        if self.conf.attrs.get("select_first", False):
-            y = sops.seq_first(arg.value, arg.seq_lens)
-        else:
-            y = sops.seq_last(arg.value, arg.seq_lens)
-        return Arg(value=y)
+        first = self.conf.attrs.get("select_first", False)
+        stride = self.conf.attrs.get("stride", 0) or 0
+        level = self.conf.attrs.get("level", "seq")
+        pick = sops.seq_first if first else sops.seq_last
+        if level == "subseq" and arg.subseq_lens is not None:
+            # one frame per subsequence: [B,T,...] + subseq_lens [B,S]
+            # -> [B,S,...] plain sequence over subsequences
+            sub = arg.subseq_lens
+            csum = jnp.cumsum(sub, axis=1)
+            starts = csum - sub  # [B, S]
+            idx = jnp.where(
+                sub > 0,
+                starts if first else csum - 1,
+                0,
+            )
+            y = jnp.take_along_axis(
+                arg.value,
+                idx[..., None].astype(jnp.int32).clip(0),
+                axis=1,
+            )
+            n_sub = (sub > 0).sum(axis=1).astype(jnp.int32)
+            return Arg(value=y, seq_lens=n_sub)
+        if stride > 0:
+            # one frame per stride-window: window w of example b is
+            # valid when w*stride < len; its frame is the last (first)
+            # valid timestep inside [w*stride, min(len, (w+1)*stride))
+            t = arg.value.shape[1]
+            n_w = -(-t // stride)  # ceil
+            lens = arg.seq_lens
+            w = jnp.arange(n_w)[None, :]  # [1, W]
+            start = w * stride
+            end = jnp.minimum(start + stride, lens[:, None])
+            idx = (start if first else end - 1).clip(0, t - 1)
+            y = jnp.take_along_axis(
+                arg.value, idx[..., None].astype(jnp.int32), axis=1
+            )
+            out_lens = -(-lens // stride)
+            return Arg(value=y, seq_lens=out_lens.astype(jnp.int32))
+        return Arg(value=pick(arg.value, arg.seq_lens))
 
 
 @LAYERS.register("expand")
 class ExpandLayer(Layer):
-    """Broadcast a [B,D] vector along the time axis of a reference sequence
-    (ExpandLayer.cpp). inputs: [x, seq_ref]."""
+    """Broadcast along the time axis of a reference sequence
+    (ExpandLayer.cpp). inputs: [x, seq_ref]. Default (FROM_NO_SEQUENCE)
+    x is [B,D] repeated per timestep; expand_level="seq"
+    (FROM_SEQUENCE) x is a sequence with one frame per SUB-sequence of
+    the NESTED ref, each frame repeated over its subsequence."""
 
     def build(self, in_specs):
         x, ref = in_specs
+        if (self.conf.attrs.get("expand_level") == "seq"
+                and ref.has_subseq):
+            return Spec(dim=x.dim, is_seq=True, has_subseq=True), {}
+        # FROM_SEQUENCE over a PLAIN (non-nested) ref coincides with
+        # the default whole-sequence broadcast (one x entry per
+        # sequence either way)
         return Spec(dim=x.dim, is_seq=True), {}
 
     def forward(self, params, inputs, ctx):
         x, ref = inputs
         t = ref.max_len
+        if (self.conf.attrs.get("expand_level") == "seq"
+                and ref.subseq_lens is not None):
+            # x [B,S,D], ref subseq_lens [B,S]: timestep t belongs to
+            # subsequence j(t) = #(subseq starts <= t) - 1
+            sub = ref.subseq_lens
+            csum = jnp.cumsum(sub, axis=1)  # [B, S]
+            pos = jnp.arange(t)[None, :, None]  # [1, T, 1]
+            j = jnp.sum(pos >= csum[:, None, :], axis=-1)  # [B, T]
+            j = j.clip(0, x.value.shape[1] - 1)
+            y = jnp.take_along_axis(x.value, j[..., None], axis=1)
+            return Arg(value=y, seq_lens=ref.seq_lens,
+                       subseq_lens=ref.subseq_lens)
         y = sops.expand_to_seq(x.value, ref.seq_lens, t)
         return Arg(value=y, seq_lens=ref.seq_lens)
 
