@@ -27,6 +27,34 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.mesh import MODEL_AXIS
 
+# Per-process cache-busting constant for layout-pinned programs,
+# embedded by adding it to the table's SCRATCH row (index V — a
+# don't-care landing zone) on TRACED outputs: an O(D) touch whose
+# distinct constant survives into the lowered module the
+# persistent-cache key hashes. Rationale: the persistent compilation
+# cache does not honor custom input/output LAYOUT contracts when an
+# executable is reloaded by a later process — the reloaded program
+# expects/produces default layouts and crashes pinned callers
+# ('Layout passed to jit does not match the layout on the respective
+# arg'). Keying each process to its own entries keeps the broken
+# reload path unreachable while in-process jit reuse (and all
+# non-layout programs' caching) stays intact. Scoping the fix at the
+# cache layer instead is not possible mid-process: the cache object
+# latches at first use, and flipping jax_enable_compilation_cache /
+# the cache dir afterwards has no effect (measured). The magnitude is
+# a small integer, exactly representable in every table dtype incl.
+# fp16/int (a subnormal-sized salt would underflow to the SAME 0.0 in
+# fp16 and silently disable the keying).
+import os as _os
+
+_PROC_SALT = float((_os.getpid() & 0x3FF) + 1)
+
+
+def _salt_scratch(table):
+    """Add the per-process salt to the scratch row only."""
+    s = jnp.asarray(_PROC_SALT, table.dtype)
+    return table.at[-1].add(s)
+
 
 def embedding_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS):
     """Gather rows from a row-sharded table.
@@ -215,9 +243,31 @@ class SparseUpdater:
             interpret = jax.devices()[0].platform != "tpu"
         self._interpret = interpret
         self._steps: dict = {}
+        # what the runtime ACTUALLY produced per (shape, dtype) — TPU
+        # tilings are dtype-dependent, so one recorded format must not
+        # be forced onto tables of another dtype
+        self._achieved_fmt: dict = {}
+        self._relayouts: dict = {}
 
     # ---- table placement ----
-    def _format(self):
+    def _format(self, shape=None, dtype=None):
+        """The table format every layout-pinned program agrees on.
+        Until a table of this (shape, dtype) is placed this is the
+        REQUESTED row-major layout; afterwards it is whatever the
+        runtime ACTUALLY produced for that request (`place` records
+        it) — runtimes differ in which layouts/tilings they honor
+        (one axon runtime honored Layout((0,1,2)) exactly, a later
+        one substituted a (1,128)-tiled variant and IGNORED custom
+        device_put layouts entirely), and hard-coding the ideal form
+        makes every pinned jit reject the real arrays. Called with no
+        key (external users sharing ONE table kind) it returns the
+        single recorded format when unambiguous."""
+        if shape is not None:
+            key = (tuple(shape), str(dtype))
+            if key in self._achieved_fmt:
+                return self._achieved_fmt[key]
+        elif len(self._achieved_fmt) == 1:
+            return next(iter(self._achieved_fmt.values()))
         from jax.experimental.layout import Format, Layout
         from jax.sharding import SingleDeviceSharding
 
@@ -227,14 +277,40 @@ class SparseUpdater:
 
     def place(self, table):
         """[V, D] -> [V, 1, D] device array in the kernel's row-major
-        layout (no per-step relayout copies)."""
+        layout (no per-step relayout copies).
+
+        The relayout runs through a per-process-salted jitted identity
+        rather than a layouted device_put: the persistent compilation
+        cache does not preserve custom layout contracts when a
+        transfer/executable is RELOADED in a later process (the array
+        arrives default-layout and every pinned consumer rejects it
+        with 'Layout passed to jit does not match...'). The salt keys
+        each process to a fresh compile of the layout-bearing
+        programs; see _jit_pinned."""
         t = np.asarray(table)
         v, d = t.shape
         # +1 scratch row: the landing zone for fill/overflow slots
         t = np.concatenate([t, np.zeros((1, d), t.dtype)], axis=0)
         if self._interpret:
             return jnp.asarray(t.reshape(v + 1, 1, d))
-        return jax.device_put(t.reshape(v + 1, 1, d), self._format())
+        arr = jax.device_put(t.reshape(v + 1, 1, d))
+        key = (arr.shape, str(arr.dtype))
+        if key not in self._relayouts:
+            self._relayouts[key] = jax.jit(
+                _salt_scratch,
+                out_shardings=self._format(arr.shape, arr.dtype),
+            )
+        arr = self._relayouts[key](arr)
+        if key not in self._achieved_fmt:
+            # record what the runtime really produced; all pinned jits
+            # (_jit_pinned and external in_shardings users) key off it
+            self._achieved_fmt[key] = arr.format
+        else:
+            assert arr.format == self._achieved_fmt[key], (
+                f"runtime produced {arr.format} for {key}, previously "
+                f"{self._achieved_fmt[key]} — layout contract drifted"
+            )
+        return arr
 
     @staticmethod
     def unplace(table):
@@ -303,16 +379,33 @@ class SparseUpdater:
 
         return step_once
 
-    def _jit_pinned(self, fn, n_state):
+    def _jit_pinned(self, fn, n_state, V=None, D=None, dtype=None):
         """Donating jit with the table layouts pinned on BOTH sides:
         without out_shardings the compiler would emit outputs in the
         default (dim0-minor) layout and every subsequent step would pay
-        two full-table relayout copies on entry."""
+        two full-table relayout copies on entry.
+
+        The program carries a PER-PROCESS constant: the persistent XLA
+        compilation cache does not honor the pinned input layouts when
+        an executable is reloaded in a later process ('Layout passed
+        to jit does not match the layout on the respective arg'), so
+        each process keys its own entry and the broken cross-process
+        reload path can never trigger. In-process jit reuse is
+        unaffected."""
+        def salted(param, state, ids, grads):
+            out_p, out_s = fn(param, state, ids, grads)
+            # O(D) touch of the don't-care scratch row only
+            return _salt_scratch(out_p), out_s
+
         if self._interpret:
-            return jax.jit(fn, donate_argnums=(0, 1))
-        fmt = self._format()
+            return jax.jit(salted, donate_argnums=(0, 1))
+        fmt = (
+            self._format((V + 1, 1, D), dtype)
+            if V is not None
+            else self._format()
+        )
         return jax.jit(
-            fn,
+            salted,
             donate_argnums=(0, 1),
             in_shardings=(fmt, (fmt,) * n_state, None, None),
             out_shardings=(fmt, (fmt,) * n_state),
@@ -320,7 +413,8 @@ class SparseUpdater:
 
     def _build(self, V, D, k, n_state, dtype):
         call = self._make_call(V, D, k, n_state, dtype)
-        return self._jit_pinned(self._one_step(call, V, k), n_state)
+        return self._jit_pinned(self._one_step(call, V, k), n_state,
+                                V=V, D=D, dtype=dtype)
 
     def _build_multi(self, V, D, k, n_state, dtype, n_steps):
         """n_steps updates inside ONE jitted program (lax.fori_loop over
@@ -345,7 +439,7 @@ class SparseUpdater:
                 0, n_steps, body, (param, tuple(state))
             )
 
-        return self._jit_pinned(steps, n_state)
+        return self._jit_pinned(steps, n_state, V=V, D=D, dtype=dtype)
 
     def __call__(self, param, ids, grads, state=()):
         V = param.shape[0] - 1  # last row is scratch
