@@ -1,0 +1,9 @@
+"""`paddle.proto.ModelConfig_pb2` shim — ModelConfig/SubModelConfig as
+reference code imports them (proto/ModelConfig.proto:608,579), aliased
+to the framework's ModelConf IR (layers/parameters/input+output names
+carry the same meaning)."""
+
+from paddle_tpu.core.config import ModelConf as ModelConfig
+from paddle_tpu.core.config import ModelConf as SubModelConfig
+
+__all__ = ["ModelConfig", "SubModelConfig"]

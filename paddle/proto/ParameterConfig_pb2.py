@@ -1,0 +1,49 @@
+"""`paddle.proto.ParameterConfig_pb2` shim.
+
+Reference: proto/ParameterConfig.proto (ParameterConfig message,
+fields name=1, size=2, dims=9, plus the optimizer scalars). A plain
+Python message class with the same attribute surface; required-field
+semantics for IsInitialized() match the proto (name and size are
+required, everything else optional with proto defaults).
+"""
+
+__all__ = ["ParameterConfig"]
+
+
+class ParameterConfig:
+    def __init__(self, **kwargs):
+        self.name = None
+        self.size = None
+        self.learning_rate = 1.0
+        self.momentum = 0.0
+        self.initial_mean = 0.0
+        self.initial_std = 0.01
+        self.decay_rate = 0.0
+        self.decay_rate_l1 = 0.0
+        self.dims = []
+        self.device = -1
+        self.initial_strategy = 0
+        self.initial_smart = False
+        self.num_batches_regularization = 1
+        self.is_sparse = False
+        self.format = ""
+        self.sparse_remote_update = False
+        self.gradient_clipping_threshold = 0.0
+        self.is_static = False
+        self.para_id = 0
+        self.need_compact = False
+        self.sparse_update = False
+        self.is_shared = False
+        self.parameter_block_size = 0
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def IsInitialized(self) -> bool:
+        # proto2 required fields: name (=1), size (=2)
+        return self.name is not None and self.size is not None
+
+    def __repr__(self):
+        return (
+            f"ParameterConfig(name={self.name!r}, size={self.size}, "
+            f"dims={list(self.dims)})"
+        )

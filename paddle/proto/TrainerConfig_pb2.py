@@ -1,0 +1,8 @@
+"""`paddle.proto.TrainerConfig_pb2` shim — OptimizationConfig is the
+name reference code imports (proto/TrainerConfig.proto); it aliases the
+framework's OptimizationConf IR (same field names: batch_size,
+learning_rate, learning_method, ...)."""
+
+from paddle_tpu.core.config import OptimizationConf as OptimizationConfig
+
+__all__ = ["OptimizationConfig"]

@@ -1,0 +1,7 @@
+"""`paddle.proto` shim — the proto-message surface reference user code
+imports (proto/ParameterConfig.proto et al.), backed by plain Python
+message classes instead of generated protobuf bindings. The framework's
+IR is paddle_tpu.core.config; these classes exist so reference programs
+that build/inspect proto messages directly (e.g.
+python/paddle/v2/tests/test_parameters.py) run unmodified.
+"""

@@ -15,6 +15,24 @@ from paddle_tpu.data.feeder import (  # noqa: F401
 )
 from paddle_tpu.data.provider import CacheType, provider  # noqa: F401
 
+
+class DataType:
+    """Slot kind enum (reference PyDataProvider2.py:32)."""
+
+    Dense = 0
+    SparseNonValue = 1
+    SparseValue = 2
+    Index = 3
+
+
+class SequenceType:
+    """Sequence level enum (reference PyDataProvider2.py:25)."""
+
+    NO_SEQUENCE = 0
+    SEQUENCE = 1
+    SUB_SEQUENCE = 2
+
+
 # older alias used by some reference providers
 sparse_vector = sparse_float_vector
 

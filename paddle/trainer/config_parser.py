@@ -10,6 +10,11 @@ from paddle_tpu.compat.config_parser import (  # noqa: F401
     parse_config,
 )
 
+
+def parse_config_and_serialize(trainer_config, config_arg_str=""):
+    """Reference config_parser.py:3756 — parse + SerializeToString."""
+    return parse_config(trainer_config, config_arg_str).SerializeToString()
+
 # the reference module's glog-backed logger the api demo drivers import
 # (v1_api_demo/vae/vae_train.py:23)
 logger = logging.getLogger("paddle_tpu.config_parser")

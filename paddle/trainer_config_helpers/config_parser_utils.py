@@ -1,0 +1,38 @@
+"""`paddle.trainer_config_helpers.config_parser_utils` shim.
+
+Reference: python/paddle/trainer_config_helpers/config_parser_utils.py
+— thin wrappers that split parse_config into network / optimizer /
+trainer flavors (parse_network_config drives parse_config with a
+callable; reset_parser restarts the ambient parse state).
+"""
+
+from paddle_tpu.compat.config_parser import parse_config as _parse_config
+
+__all__ = [
+    "parse_trainer_config",
+    "parse_network_config",
+    "parse_optimizer_config",
+    "reset_parser",
+]
+
+
+def parse_trainer_config(trainer_conf, config_arg_str=""):
+    return _parse_config(trainer_conf, config_arg_str)
+
+
+def parse_network_config(network_conf, config_arg_str=""):
+    config = _parse_config(network_conf, config_arg_str)
+    return config.model_config
+
+
+def parse_optimizer_config(optimizer_conf, config_arg_str=""):
+    config = _parse_config(optimizer_conf, config_arg_str)
+    return config.opt_config
+
+
+def reset_parser():
+    """Reference reset_parser -> config_parser.begin_parse(): drop all
+    ambient graph state so the next parse starts fresh."""
+    from paddle.v2 import config_base
+
+    config_base.reset()

@@ -1,13 +1,115 @@
 """paddle.v2.data_feeder — DataFeeder re-export.
 
 Reference: python/paddle/v2/data_feeder.py (DataFeeder(data_types,
-feeding) converting sample tuples into Arguments). Backed by
-paddle_tpu.data.feeder.DataFeeder (ragged -> packed dense batches).
+feeding) converting sample tuples into swig Arguments via
+DataProviderConverter). Backed by paddle_tpu.data.feeder.DataFeeder
+(ragged -> packed dense batches) for the trainer path; the returned
+batch ALSO exposes the reference Arguments slot surface
+(getSlotValue/getSlotIds/getSlotSequenceStartPositions/
+getSlotFrameHeight...), slot-indexed in data_types order, so reference
+programs that inspect the converted batch run unmodified
+(python/paddle/v2/tests/test_data_feeder.py).
 """
 
+import jax
+import numpy as np
+
+from paddle_tpu.compat import swig_api as _api
 from paddle_tpu.data.feeder import DataFeeder as _DataFeeder
 
 __all__ = ["DataFeeder"]
+
+
+class FeedBatch(dict):
+    """The feed dict (layer name -> Arg) with the reference Arguments
+    slot surface layered on top. Slot i is data_types[i]; accessors
+    compute from the raw sample column, so sparse slots report their
+    original indices (not the densified packing the trainer consumes).
+    """
+
+    def __init__(self, feed, slots):
+        super().__init__(feed)
+        self._slots = slots  # [(name, InputType, raw_column)]
+
+    def getSlotNum(self):
+        return len(self._slots)
+
+    def getSlotValue(self, i) -> _api.Matrix:
+        _, t, col = self._slots[i]
+        if t.kind in ("sparse_binary", "sparse_float"):
+            # sequence slots flatten timesteps into rows (the
+            # reference's padding-free (sum_T, dim) matrix)
+            rows = (
+                [step for s in col for step in s] if t.seq else col
+            )
+            return _api.SparseMatrix(
+                rows, t.shape[0], with_values=t.kind == "sparse_float"
+            )
+        if t.seq:
+            rows = [
+                np.asarray(s, np.float32).reshape(-1, t.size)
+                for s in col
+            ]
+            return _api.Matrix.createDenseFromNumpy(
+                np.concatenate(rows, axis=0)
+            )
+        flat = [np.asarray(s, np.float32).ravel() for s in col]
+        return _api.Matrix.createDenseFromNumpy(np.stack(flat))
+
+    def getSlotIds(self, i) -> _api.IVector:
+        _, t, col = self._slots[i]
+        if t.seq:
+            return _api.IVector(
+                np.concatenate(
+                    [np.asarray(s, np.int32).ravel() for s in col]
+                )
+            )
+        return _api.IVector(np.asarray(col, np.int32).ravel())
+
+    def _row_counts(self, i):
+        """Timesteps per sample — id slots count ids, dense slots count
+        dim-wide rows, sparse slots count per-step index lists."""
+        _, t, col = self._slots[i]
+        if t.kind == "ids":
+            return [len(np.asarray(s).ravel()) for s in col]
+        if t.kind == "dense":
+            return [
+                np.asarray(s, np.float32).reshape(-1, t.size).shape[0]
+                for s in col
+            ]
+        return [len(s) for s in col]
+
+    def getSlotSequenceStartPositions(self, i) -> _api.IVector:
+        lens = self._row_counts(i)
+        return _api.IVector(np.concatenate([[0], np.cumsum(lens)]))
+
+    def getSlotFrameHeight(self, i) -> int:
+        _, _, col = self._slots[i]
+        a = np.asarray(col[0])
+        return int(a.shape[-2]) if a.ndim >= 2 else 0
+
+    def getSlotFrameWidth(self, i) -> int:
+        _, _, col = self._slots[i]
+        a = np.asarray(col[0])
+        return int(a.shape[-1]) if a.ndim >= 2 else 0
+
+
+def _feed_batch_flatten(fb):
+    keys = sorted(fb.keys())
+    return [fb[k] for k in keys], tuple(keys)
+
+
+def _feed_batch_unflatten(keys, vals):
+    # a plain feed dict — the slot columns are host-side metadata and
+    # don't survive tracing
+    return dict(zip(keys, vals))
+
+
+# jit sees FeedBatch as the feed dict (dict subclasses aren't pytrees
+# by default; without this the trainer can't take a FeedBatch feed)
+jax.tree_util.register_pytree_node(
+    FeedBatch, _feed_batch_flatten, _feed_batch_unflatten
+)
 
 
 class DataFeeder(_DataFeeder):
@@ -15,6 +117,7 @@ class DataFeeder(_DataFeeder):
         # v2 call shape: DataFeeder(data_types, feeding) where
         # data_types is [(name, InputType)]; internal call shape:
         # DataFeeder(feeding_dict, types_dict)
+        self._slot_order = None
         if types is None or (
             isinstance(feeding, (list, tuple))
             and feeding
@@ -22,9 +125,23 @@ class DataFeeder(_DataFeeder):
         ):
             data_types, feeding = feeding, types
             types = dict(data_types)
+            self._slot_order = [n for n, _ in data_types]
             if feeding is None:
                 feeding = {n: i for i, (n, _) in enumerate(data_types)}
             elif isinstance(feeding, (list, tuple)):
                 feeding = {n: i for i, n in enumerate(feeding)}
             feeding = {k: v for k, v in feeding.items() if k in types}
         super().__init__(feeding, types)
+
+    def convert(self, batch):
+        feed = super().convert(batch)
+        order = self._slot_order or list(self.feeding)
+        slots = [
+            (
+                n,
+                self.types[n],
+                [sample[self.feeding[n]] for sample in batch],
+            )
+            for n in order
+        ]
+        return FeedBatch(feed, slots)

@@ -19,6 +19,10 @@ from paddle_tpu.data.feeder import (
 
 sparse_vector = sparse_float_vector
 
+# variable-shape dense feature (PyDataProvider2.py:147 dense_array =
+# dense_slot); the feeder reads frame height/width off 3-D samples
+dense_array = dense_vector
+
 
 def dense_vector_sub_sequence(dim):
     return dense_vector(dim, 2)
@@ -41,4 +45,5 @@ __all__ = [
     "sparse_binary_vector", "sparse_binary_vector_sequence",
     "sparse_float_vector", "sparse_float_vector_sequence",
     "sparse_vector", "sparse_vector_sequence",
+    "dense_array",
 ]

@@ -6,6 +6,9 @@ mixed layers with the activation applied; add/sub via dsl arithmetic).
 
 from __future__ import annotations
 
+from paddle_tpu.compat import layer_math  # noqa: F401  (patches +,-,*
+#    onto LayerRef — reference op.py registers the same operators,
+#    op.py __register_binary_math_op__)
 from paddle_tpu.compat import layers_v1 as _v1
 
 from . import activation as act

@@ -41,6 +41,27 @@ class Parameters:
         self.__params__: dict[str, np.ndarray] = {}
         self.__param_confs__: dict = {}
 
+    def __append_config__(self, param_conf):
+        """Register a ParameterConfig and allocate its (zeroed) buffer
+        (reference parameters.py __append_config__; shape is dims when
+        set, else (1, size) — reference get_shape()). Accepts the
+        paddle.proto.ParameterConfig_pb2 shim or anything with
+        name/size/dims."""
+        if not param_conf.IsInitialized():
+            raise ValueError("param_conf must be initialized")
+        if param_conf.name in self.__params__:
+            raise ValueError(f"duplicated parameter {param_conf.name}")
+        dims = tuple(int(d) for d in param_conf.dims) or (
+            1,
+            int(param_conf.size),
+        )
+        from paddle_tpu.core.config import ParameterConf as _PC
+
+        self.__param_confs__[param_conf.name] = _PC(
+            name=param_conf.name, dims=dims
+        )
+        self.__params__[param_conf.name] = np.zeros(dims, np.float32)
+
     # --- dict surface (parameters.py:43 "plain numpy dict") ---
     def names(self):
         return list(self.__params__)

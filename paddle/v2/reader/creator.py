@@ -1,8 +1,12 @@
 """paddle.v2.reader.creator — readers from arrays/files.
 
-Reference: python/paddle/v2/reader/creator.py (np_array, text_file).
+Reference: python/paddle/v2/reader/creator.py (np_array, text_file,
+recordio). `recordio` reads the reference recordio wire format
+(snappy-framed chunks of pickled records) as well as this framework's
+native chunk files.
 """
 
 from paddle_tpu.data.reader import np_array, text_file
+from paddle_tpu.data.reader import recordio_interop as recordio
 
-__all__ = ["np_array", "text_file"]
+__all__ = ["np_array", "text_file", "recordio"]

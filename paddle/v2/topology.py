@@ -19,11 +19,20 @@ from . import config_base
 
 
 def _as_names(layers):
+    """Flatten arbitrarily nested layer/list arguments to names —
+    reference parse_network accepts mixed nesting
+    (`parse_network([maxpool, spp], other)`, layer.py:263)."""
     if layers is None:
         return []
     if not isinstance(layers, (list, tuple)):
         layers = [layers]
-    return [getattr(x, "name", x) for x in layers]
+    out = []
+    for x in layers:
+        if isinstance(x, (list, tuple)):
+            out.extend(_as_names(x))
+        else:
+            out.append(getattr(x, "name", x))
+    return out
 
 
 class Topology:
@@ -33,6 +42,7 @@ class Topology:
             raise ValueError("Topology needs at least one output layer")
         extra = _as_names(extra_layers)
         g = config_base.global_graph()
+        self._src_builder = g
         src = g.conf
 
         by_name = {lc.name: lc for lc in src.layers}
@@ -98,6 +108,17 @@ class Topology:
     def proto(self) -> ModelConf:
         """The pruned ModelConf (the analogue of topology.proto())."""
         return self.conf
+
+    def get_layer(self, name: str):
+        """The layer handle for `name` (reference topology.py
+        get_layer). LayerRef equality is structural (frozen dataclass
+        over (name, graph)), so this compares equal to the handle the
+        original layer call returned."""
+        from paddle_tpu import dsl
+
+        if not any(lc.name == name for lc in self.conf.layers):
+            raise ValueError(f"layer {name!r} not in this topology")
+        return dsl.LayerRef(name, self._src_builder)
 
     def data_type(self):
         """[(data_layer_name, InputType)] in declaration order

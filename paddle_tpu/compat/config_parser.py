@@ -74,6 +74,8 @@ __all__ = [
     "AvgPooling",
     "SumPooling",
     "SqrtAvgPooling",
+    "CudnnMaxPooling",
+    "CudnnAvgPooling",
 ]
 
 
@@ -261,6 +263,16 @@ class SumPooling(_Pooling):
 
 class SqrtAvgPooling(_Pooling):
     name = "sqrt_average"
+
+
+# cudnn-tagged spellings (poolings.py CudnnMaxPooling/CudnnAvgPooling);
+# the device-specific implementation distinction is XLA's business
+class CudnnMaxPooling(_Pooling):
+    name = "cudnn_max"
+
+
+class CudnnAvgPooling(_Pooling):
+    name = "cudnn_avg"
 
 
 # ---- data sources --------------------------------------------------------
@@ -548,6 +560,14 @@ class TrainerConfig:
         elif hasattr(self, name):
             setattr(self, name, None)
 
+    def SerializeToString(self) -> bytes:
+        """Deterministic wire form (the reference returns the
+        TrainerConfig proto's SerializeToString,
+        config_parser.py:3760). Dataclass reprs are deterministic, so
+        equal configs serialize equal — the property
+        parse_config_and_serialize callers rely on."""
+        return repr(self).encode()
+
 
 def _parse_args(config_args) -> dict:
     if not config_args:
@@ -563,9 +583,13 @@ def _parse_args(config_args) -> dict:
     return out
 
 
-def parse_config(config_file: str, config_args="") -> TrainerConfig:
+def parse_config(config_file, config_args="") -> TrainerConfig:
     """Exec a v1 config file (config_parser.py:3724 parse_config).
 
+    `config_file` may also be a callable (the reference parse_config
+    accepts a function and calls it inside the parse scope —
+    config_parser.py:3732 `if hasattr(trainer_config, '__call__')`;
+    that is how config_parser_utils.parse_network_config drives it).
     `config_args` is the CLI `--config_args` string ("a=1,b=2") or a
     dict; values reach the config via `get_config_arg`. The file's own
     `from paddle.trainer_config_helpers import *` resolves through the
@@ -577,15 +601,19 @@ def parse_config(config_file: str, config_args="") -> TrainerConfig:
     ctx = _ParseCtx(_parse_args(config_args))
     _stack.append(ctx)
     try:
-        with open(config_file) as f:
-            code = compile(f.read(), config_file, "exec")
-        ns = {
-            "__file__": os.path.abspath(config_file),
-            "__name__": "__paddle_config__",
-            "xrange": range,  # py2-era configs
-        }
-        with dsl.model() as g:
-            exec(code, ns)
+        if callable(config_file):
+            with dsl.model() as g:
+                config_file()
+        else:
+            with open(config_file) as f:
+                code = compile(f.read(), config_file, "exec")
+            ns = {
+                "__file__": os.path.abspath(config_file),
+                "__name__": "__paddle_config__",
+                "xrange": range,  # py2-era configs
+            }
+            with dsl.model() as g:
+                exec(code, ns)
         conf = g.conf
     finally:
         _stack.pop()
@@ -596,7 +624,7 @@ def parse_config(config_file: str, config_args="") -> TrainerConfig:
     if ctx.inputs:
         # inputs() fixes the data-layer FEED ORDER
         conf.input_layer_names = list(ctx.inputs)
-    if ctx.data_sources is not None:
+    if ctx.data_sources is not None and not callable(config_file):
         ctx.data_sources.search_dir = os.path.dirname(
             os.path.abspath(config_file)
         )

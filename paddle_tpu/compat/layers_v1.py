@@ -58,6 +58,7 @@ __all__ = [
     "full_matrix_projection",
     "trans_full_matrix_projection",
     "identity_projection",
+    "slice_projection",
     "dotmul_projection",
     "scaling_projection",
     "table_projection",
@@ -121,9 +122,12 @@ __all__ = [
     "cross_entropy_with_selfnorm",
     "smooth_l1_cost",
     "print_layer",
+    "printer_layer",
     "pad_layer",
     "crop_layer",
     "trans_layer",
+    "rotate_layer",
+    "out_prod_layer",
     "row_l2_norm_layer",
     "sum_to_one_norm_layer",
     "conv_operator",
@@ -221,8 +225,10 @@ def _act_or(a, default: str) -> str:
 
 def ParamAttr(name=None, initial_std=None, initial_mean=0.0,
               learning_rate=1.0, l1_rate=None, l2_rate=None,
-              is_static=False, sparse_update=False, **_):
-    """(trainer_config_helpers/attrs.py ParamAttr)."""
+              is_static=False, sparse_update=False, initializer=None,
+              **_):
+    """(trainer_config_helpers/attrs.py ParamAttr; `initializer` is the
+    v2 extension — a name -> ndarray callback, v2/attr.py)."""
     return ParameterConf(
         name=name or "",
         initial_std=initial_std,
@@ -232,6 +238,7 @@ def ParamAttr(name=None, initial_std=None, initial_mean=0.0,
         decay_rate=l2_rate,
         is_static=is_static,
         sparse_update=sparse_update,
+        initializer=initializer,
     )
 
 
@@ -350,14 +357,15 @@ def addto_layer(input, act=None, name=None, bias_attr=False, **_):
                      bias=bool(bias_attr))
 
 
-def concat_layer(input, name=None, **_):
+def concat_layer(input, act=None, bias_attr=False, name=None, **_):
     # v1 concat also accepts PROJECTIONS as inputs (layers.py
     # concat_layer); materialize each as a one-term sizeless mixed
     ins = [
         dsl.mixed(0, [x], bias=False) if isinstance(x, tuple) else x
-        for x in _many(input)
+        for x in _edges(input)
     ]
-    return dsl.concat(*ins, name=name)
+    return dsl.concat(*ins, name=name, act=_act(act),
+                      bias=bool(bias_attr))
 
 
 def dropout_layer(input, dropout_rate, name=None, **_):
@@ -583,10 +591,24 @@ class _MixedLayerBuilder:
         return self._ref.__rmul__(other)
 
 
+def _edges(input):
+    """Mixed-layer input normalization: a single projection/operator
+    edge is a (layer, proj[, extra]) tuple — don't let _many flatten
+    it into bogus separate inputs (mixed_layer(input=table_projection(
+    ...)) is the reference idiom, layers.py MixedLayerType)."""
+    if (
+        isinstance(input, tuple)
+        and len(input) >= 2
+        and isinstance(input[1], str)
+    ):
+        return [input]
+    return _many(input)
+
+
 def mixed_layer(size=0, input=None, act=None, name=None, bias_attr=False, **_):
     if input is None:
         return _MixedLayerBuilder(size, act, name, bias_attr)
-    return dsl.mixed(size, _many(input), name=name, act=_act(act),
+    return dsl.mixed(size, _edges(input), name=name, act=_act(act),
                      bias=bool(bias_attr))
 
 
@@ -631,9 +653,22 @@ def trans_full_matrix_projection(input, size=0, param_attr=None, **_):
     return (_one(input), "trans_full_matrix", extra)
 
 
-def identity_projection(input, offset=None, **_):
-    assert offset is None, "identity_projection offset not supported"
+def identity_projection(input, offset=None, size=None, **_):
+    if offset is not None:
+        # IdentityOffsetProjection (layers.py identity_projection
+        # offset=): a single [offset, offset+size) slice
+        end = offset + (size or (_layer_size(input) - offset))
+        return (_one(input), "slice", {"slices": ((offset, end),)})
     return (_one(input), "identity")
+
+
+def slice_projection(input, slices, **_):
+    """(layers.py slice_projection; SliceProjection.cpp) — concat of
+    [start, end) feature slices of the input."""
+    for s, e in slices:
+        assert 0 <= s < e, f"bad slice ({s}, {e})"
+    return (_one(input), "slice",
+            {"slices": tuple((int(s), int(e)) for s, e in slices)})
 
 
 def dotmul_projection(input, param_attr=None, **_):
@@ -655,7 +690,14 @@ def table_projection(input, size=0, param_attr=None, **_):
     if lc.type == "data" and not lc.attrs.get("is_ids"):
         lc.attrs["is_ids"] = True
         lc.attrs["is_seq"] = True
-    return (x, "table", {"vocab_size": lc.size})
+    extra = {"vocab_size": lc.size}
+    if size:
+        # a declared projection size fixes a sizeless host mixed
+        # (table_projection(size=...) under concat, concat_table_b)
+        extra["proj_size"] = size
+    if param_attr is not None:
+        extra["param"] = param_attr
+    return (x, "table", extra)
 
 
 def context_projection(input, context_len, context_start=None, **_):
@@ -832,6 +874,11 @@ def print_layer(input, format=None, name=None, **_):
     # the reference returns None (print is a side effect)
 
 
+# the primary spelling upstream (layers.py:1023 printer_layer;
+# print_layer kept for v1 compat, :1046-1051) — v2 renames it `printer`
+printer_layer = print_layer
+
+
 def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
               **_):
     return dsl._add("pad", [_one(input)], name=name, bias=False,
@@ -857,6 +904,20 @@ def trans_layer(input, name=None, **_):
     return dsl._add("trans", [_one(input)], name=name, bias=False)
 
 
+def rotate_layer(input, height, width, name=None, **_):
+    """(layers.py rotate_layer; RotateLayer.cpp) — rotate each
+    height x width channel plane 90 degrees clockwise."""
+    return dsl._add("rotate", [_one(input)], name=name, bias=False,
+                    height=height, width=width)
+
+
+def out_prod_layer(input1, input2, name=None, **_):
+    """(layers.py out_prod_layer; OuterProdLayer.cpp) — flattened
+    outer product of two vectors."""
+    return dsl._add("out_prod", [_one(input1), _one(input2)],
+                    name=name, bias=False)
+
+
 def row_l2_norm_layer(input, name=None, **_):
     return dsl._add("row_l2_norm", [_one(input)], name=name, bias=False)
 
@@ -880,19 +941,42 @@ def conv_operator(img, filter, filter_size, num_filters,
         filter_size=filter_size, stride=stride, padding=padding,
         trans=bool(trans),
     )
+    # parse-time output size so a sizeless mixed_layer knows its width
+    # immediately (reference ConvOperator computes it in the config
+    # parser: num_filters * out_x * out_y over the square image)
+    out_size = 0
+    if not trans:
+        import math
+
+        pixels = _layer_size(img) // max(num_channels, 1)
+        side = int(math.isqrt(pixels))
+        if side * side == pixels:
+            fy = filter_size_y or filter_size
+            sy = stride_y or stride
+            py = padding if padding_y is None else padding_y
+            ox = (side + 2 * padding - filter_size) // stride + 1
+            oy = (side + 2 * py - fy) // sy + 1
+            if ox > 0 and oy > 0:
+                out_size = num_filters * ox * oy
+    if out_size:
+        return (ref, "identity", {"proj_size": out_size})
     return (ref, "identity")
 
 
 def conv_projection(input, filter_size, num_filters, num_channels=1,
-                    stride=1, padding=0, trans=False, param_attr=None,
-                    **_):
+                    stride=1, padding=0, groups=1, trans=False,
+                    param_attr=None, **_):
     """(layers.py conv_projection) — learned-weight conv as a mixed
     term; materializes a conv (or conv-transpose) layer."""
     f = dsl.conv_trans if trans else dsl.conv
+    kw = {} if trans else {"groups": groups}
     ref = f(_one(input), num_filters, filter_size, stride=stride,
-            padding=padding, act="", param=param_attr,
-            num_channels=num_channels)
-    return (ref, "identity")
+            padding=padding, act="", bias=False, param=param_attr,
+            num_channels=num_channels, **kw)
+    # a projection has no bias of its own; the host mixed layer's bias
+    # is SHARED per filter for conv projections (config_parser.py:2984
+    # shared_biases=True, bias_size=sum(calc_bias_size))
+    return (ref, "identity", {"conv_bias": num_filters})
 
 
 def priorbox_layer(input, image, min_size, max_size=(), aspect_ratio=(),

@@ -24,13 +24,17 @@ _FIXES = [
 ]
 
 
-def to_py3(src: str, name: str = "<py2 script>") -> str:
-    """Mechanical py2 -> py3 source conversion (no-op if already py3)."""
-    try:
-        compile(src, name, "exec")
-        return src
-    except SyntaxError:
-        pass
+def to_py3(src: str, name: str = "<py2 script>", force: bool = False) -> str:
+    """Mechanical py2 -> py3 source conversion (no-op if already py3,
+    unless `force` — a py2 file can be VALID py3 syntax with different
+    semantics, e.g. `len(filter(...))` relying on filter returning a
+    list; force runs the fixers regardless)."""
+    if not force:
+        try:
+            compile(src, name, "exec")
+            return src
+        except SyntaxError:
+            pass
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")  # lib2to3 deprecation
         from lib2to3.refactor import RefactoringTool

@@ -67,6 +67,18 @@ def initPaddle(*args):
             _flags.set_flag(name, cast(v))
 
 
+def isGpuVersion() -> bool:
+    """api.isGpuVersion — whether a CUDA build is running. This build
+    targets TPU via XLA; the GPU-specific re-run paths reference tests
+    gate on this (test_data_feeder.py main) don't apply."""
+    return False
+
+
+def setUseGpu(flag: bool) -> None:
+    """api.setUseGpu — accepted for parity; device placement is XLA's
+    (the axon TPU backend is used whenever present)."""
+
+
 def _as2d(a: np.ndarray) -> np.ndarray:
     a = np.asarray(a)
     return a.reshape(a.shape[0], -1) if a.ndim != 2 else a
@@ -104,6 +116,48 @@ class Matrix:
 
     def getWidth(self):
         return self._a.shape[1]
+
+    def isSparse(self):
+        return False
+
+
+class SparseMatrix(Matrix):
+    """Row-sparse host matrix (api/Paddle.i createSparse;
+    Matrix::getSparseRowCols). Built from per-row column-index lists
+    (binary) or (col, value) pair lists (float); densifies lazily for
+    the dense Matrix surface."""
+
+    def __init__(self, rows, width, with_values=False):
+        self._rows = [list(r) for r in rows]
+        self._w = int(width)
+        self._with_values = with_values
+        self._dense = None
+
+    @property
+    def _a(self):
+        if self._dense is None:
+            d = np.zeros((len(self._rows), self._w), np.float32)
+            for i, row in enumerate(self._rows):
+                for e in row:
+                    if self._with_values:
+                        d[i, int(e[0])] = float(e[1])
+                    else:
+                        d[i, int(e)] = 1.0
+            self._dense = d
+        return self._dense
+
+    def isSparse(self):
+        return True
+
+    def getSparseRowCols(self, i):
+        if self._with_values:
+            return [int(c) for c, _ in self._rows[i]]
+        return [int(c) for c in self._rows[i]]
+
+    def getSparseRowColsVal(self, i):
+        if self._with_values:
+            return [(int(c), float(v)) for c, v in self._rows[i]]
+        return [(int(c), 1.0) for c in self._rows[i]]
 
 
 class _VectorBase:
@@ -184,6 +238,18 @@ class Arguments:
 
     def setSlotSubSequenceStartPositions(self, i, v: IVector):
         self._slot(i)["subseq_starts"] = v
+
+    def setSlotFrameHeight(self, i, h: int):
+        self._slot(i)["frame_h"] = int(h)
+
+    def setSlotFrameWidth(self, i, w: int):
+        self._slot(i)["frame_w"] = int(w)
+
+    def getSlotFrameHeight(self, i) -> int:
+        return self._slots[i].get("frame_h", 0)
+
+    def getSlotFrameWidth(self, i) -> int:
+        return self._slots[i].get("frame_w", 0)
 
     def _setSlotArg(self, i, arg: Arg):
         self._slot(i)["arg"] = arg

@@ -42,6 +42,9 @@ class ParameterConf:
     # MoE expert weight [E, ...]: shard the leading expert dim over the
     # mesh model axis (expert parallelism)
     expert_sharded: bool = False
+    # user callback name -> ndarray (reference ParameterAttribute
+    # initializer, python/paddle/v2/attr + parameters.py:update hooks)
+    initializer: Optional[object] = None
 
     def to_dict(self):
         d = dataclasses.asdict(self)
@@ -183,6 +186,12 @@ def _to_jsonable(obj: Any) -> Any:
         return [_to_jsonable(x) for x in obj]
     if isinstance(obj, dict):
         return {k: _to_jsonable(v) for k, v in obj.items()}
+    if callable(obj):
+        # session-only callbacks (ParameterConf.initializer, beam
+        # hooks) don't persist — values they produced already live in
+        # the checkpoint; a reloaded config falls back to the default
+        # initialization path
+        return None
     return obj
 
 

@@ -21,7 +21,7 @@ from paddle_tpu.core.arg import Arg
 @dataclass(frozen=True)
 class InputType:
     kind: str  # dense | ids | sparse_binary | sparse_float
-    dim: tuple  # feature shape
+    shape: tuple  # feature shape
     seq: int  # 0 = none, 1 = sequence, 2 = sub-sequence
     vocab: int = 0  # ids slots: the value range (v1 slot "dim")
 
@@ -32,9 +32,29 @@ class InputType:
         if self.kind == "ids":
             return self.vocab
         n = 1
-        for d in self.dim:
+        for d in self.shape:
             n *= d
         return n
+
+    # --- the reference InputType attribute surface
+    #     (PyDataProvider2.py:47 InputType(dim, seq_type, type)) ---
+    @property
+    def dim(self) -> int:
+        return self.size
+
+    @property
+    def seq_type(self) -> int:
+        return self.seq
+
+    @property
+    def type(self) -> int:
+        """DataType enum value (PyDataProvider2.py:32)."""
+        return {
+            "dense": 0,  # DataType.Dense
+            "sparse_binary": 1,  # DataType.SparseNonValue
+            "sparse_float": 2,  # DataType.SparseValue
+            "ids": 3,  # DataType.Index
+        }[self.kind]
 
 
 def dense_vector(dim, seq_type=0):
@@ -86,6 +106,30 @@ def _bucket(n: int, buckets=None) -> int:
     return b
 
 
+def _sparse_float_row(row):
+    """Normalize a sparse-float row to (indices, values). Accepts the
+    reference sample format — a sequence of (col, value) pairs
+    (PyDataProvider2.py sparse_float slots; DataProviderConverter's
+    SparseFloatScanner) — or the internal two-tuple of parallel LISTS
+    (proto_provider). A tuple of exactly two pairs is ambiguous by
+    shape; the parallel form is only recognized when both halves are
+    lists/arrays, so reference pair data can never be misread as
+    (indices, values)."""
+    if (
+        isinstance(row, tuple)
+        and len(row) == 2
+        and all(isinstance(e, (list, np.ndarray)) for e in row)
+    ):
+        return row  # internal parallel (indices, values)
+    if len(row) == 0:
+        return (), ()
+    if all(hasattr(e, "__len__") and len(e) == 2 for e in row):
+        return tuple(zip(*row))  # reference (col, value) pairs
+    if isinstance(row, tuple) and len(row) == 2:
+        return row  # parallel form with tuple storage
+    return tuple(zip(*row))
+
+
 class DataFeeder:
     """feeding maps data-layer name -> position in each sample tuple."""
 
@@ -109,18 +153,26 @@ class DataFeeder:
         b = len(column)
         if t.seq == 0:
             if t.kind == "dense":
-                v = np.asarray(column, np.float32).reshape((b,) + t.dim)
+                arr = np.asarray(column, np.float32)
+                try:
+                    v = arr.reshape((b,) + t.shape)
+                except ValueError:
+                    # dense_array: the declared dim is advisory — the
+                    # actual sample shape wins (reference
+                    # DenseScanner keeps multi-dim data as fed and
+                    # only records frame height/width)
+                    v = arr.reshape(b, -1)
                 return Arg(value=v)
             if t.kind == "ids":
                 ids = np.asarray(column, np.int64).reshape(b).astype(np.int32)
                 return Arg(ids=ids)
             if t.kind in ("sparse_binary", "sparse_float"):
-                v = np.zeros((b,) + t.dim, np.float32)
+                v = np.zeros((b,) + t.shape, np.float32)
                 for i, row in enumerate(column):
                     if t.kind == "sparse_binary":
                         v[i, np.asarray(row, np.int64)] = 1.0
                     else:
-                        idx, vals = row
+                        idx, vals = _sparse_float_row(row)
                         v[i, np.asarray(idx, np.int64)] = np.asarray(
                             vals, np.float32
                         )
@@ -133,7 +185,7 @@ class DataFeeder:
                 for i, s in enumerate(column):
                     ids[i, : len(s)] = np.asarray(s, np.int64)
                 return Arg(ids=ids, seq_lens=lens)
-            v = np.zeros((b, tmax) + t.dim, np.float32)
+            v = np.zeros((b, tmax) + t.shape, np.float32)
             if t.kind in ("sparse_binary", "sparse_float"):
                 # sequence of sparse rows: each timestep is an index
                 # list (or (indices, values)) — PyDataProvider2's
@@ -143,14 +195,14 @@ class DataFeeder:
                         if t.kind == "sparse_binary":
                             v[i, ti, np.asarray(row, np.int64)] = 1.0
                         else:
-                            idx, vals = row
+                            idx, vals = _sparse_float_row(row)
                             v[i, ti, np.asarray(idx, np.int64)] = (
                                 np.asarray(vals, np.float32)
                             )
                 return Arg(value=v, seq_lens=lens)
             for i, s in enumerate(column):
                 v[i, : len(s)] = np.asarray(s, np.float32).reshape(
-                    (len(s),) + t.dim
+                    (len(s),) + t.shape
                 )
             return Arg(value=v, seq_lens=lens)
         if t.seq == 2:
@@ -168,11 +220,11 @@ class DataFeeder:
                     flat = [tok for ss in s for tok in ss]
                     ids[i, : len(flat)] = flat
                 return Arg(ids=ids, seq_lens=flat_lens, subseq_lens=subl)
-            v = np.zeros((b, tmax) + t.dim, np.float32)
+            v = np.zeros((b, tmax) + t.shape, np.float32)
             for i, s in enumerate(column):
                 flat = np.asarray(
                     [tok for ss in s for tok in ss], np.float32
-                ).reshape(-1, *t.dim)
+                ).reshape(-1, *t.shape)
                 v[i, : len(flat)] = flat
             return Arg(value=v, seq_lens=flat_lens, subseq_lens=subl)
         raise ValueError(f"unsupported input type {t}")

@@ -40,6 +40,152 @@ def text_file(path):
     return reader
 
 
+# ---- RecordIO reading (reader/creator.py:60 recordio) ----------------
+#
+# The PaddlePaddle recordio wire format (the Go master's chunk format,
+# written by the `recordio` package): per chunk a 20-byte header
+# [magic 0x01020304, crc32, compressor, compressed-len, num-records]
+# followed by the payload — snappy FRAMING stream when compressor=1 —
+# holding [len u32][bytes] records. Python-snappy isn't available, so
+# the snappy framing + block formats are decoded here directly.
+
+
+def _snappy_block_decode(buf: bytes) -> bytes:
+    """Raw snappy block format (the framing format's COMPRESSED chunks;
+    google/snappy format_description.txt)."""
+    # uncompressed length varint
+    n = shift = i = 0
+    while True:
+        b = buf[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while i < len(buf):
+        tag = buf[i]
+        i += 1
+        kind = tag & 3
+        if kind == 0:  # literal
+            ln = tag >> 2
+            if ln >= 60:
+                nb = ln - 59
+                ln = int.from_bytes(buf[i : i + nb], "little")
+                i += nb
+            ln += 1
+            out += buf[i : i + ln]
+            i += ln
+            continue
+        if kind == 1:  # copy, 1-byte offset
+            ln = ((tag >> 2) & 0x7) + 4
+            off = ((tag >> 5) << 8) | buf[i]
+            i += 1
+        elif kind == 2:  # copy, 2-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(buf[i : i + 2], "little")
+            i += 2
+        else:  # copy, 4-byte offset
+            ln = (tag >> 2) + 1
+            off = int.from_bytes(buf[i : i + 4], "little")
+            i += 4
+        for _ in range(ln):  # overlapping copies are the RLE trick
+            out.append(out[-off])
+    assert len(out) == n, f"snappy: got {len(out)} bytes, header said {n}"
+    return bytes(out)
+
+
+def _snappy_stream_decode(buf: bytes) -> bytes:
+    """Snappy framing format (framing_format.txt): [type u8][len u24]
+    chunks — 0xff stream id, 0x00 compressed (crc + block), 0x01
+    uncompressed (crc + data), 0xfe padding."""
+    out = bytearray()
+    i = 0
+    while i < len(buf):
+        kind = buf[i]
+        ln = int.from_bytes(buf[i + 1 : i + 4], "little")
+        body = buf[i + 4 : i + 4 + ln]
+        i += 4 + ln
+        if kind == 0x00:
+            out += _snappy_block_decode(body[4:])  # skip masked crc
+        elif kind == 0x01:
+            out += body[4:]
+        # 0xff stream identifier / 0xfe padding / reserved: skip
+    return bytes(out)
+
+
+_RECORDIO_MAGIC = 0x01020304
+
+
+def recordio_records(path: str):
+    """Iterate raw record payloads of one recordio file."""
+    import struct
+    import zlib
+
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(20)
+            if len(head) < 20:
+                return
+            magic, crc, comp, clen, _nrec = struct.unpack("<IIIII", head)
+            if magic != _RECORDIO_MAGIC:
+                raise ValueError(
+                    f"{path}: bad recordio chunk magic {magic:#x}"
+                )
+            payload = f.read(clen)
+            if comp == 1:
+                data = _snappy_stream_decode(payload)
+            elif comp == 2:
+                data = zlib.decompress(payload, 31)  # gzip
+            else:
+                data = payload
+            if crc and zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise ValueError(f"{path}: recordio chunk crc mismatch")
+            i = 0
+            while i < len(data):
+                (rlen,) = struct.unpack_from("<I", data, i)
+                i += 4
+                yield data[i : i + rlen]
+                i += rlen
+
+
+def _file_records(path: str):
+    """Raw records of one record file, sniffing the container: the
+    reference recordio magic 0x01020304 decodes in-process; anything
+    else goes through the native C++ prefetch reader (PTRC chunks)."""
+    with open(path, "rb") as f:
+        magic = f.read(4)
+    if magic == b"\x04\x03\x02\x01":
+        yield from recordio_records(path)
+    else:
+        from paddle_tpu.native.recordio import RecordReader
+
+        with RecordReader([path]) as rd:
+            yield from rd
+
+
+def recordio_interop(paths, buf_size=100):
+    """Reader over pickled records in recordio files; `paths` is a
+    path, a comma-separated list, or a list (glob patterns allowed) —
+    the reference reader/creator.py:60 surface, reading BOTH the
+    reference wire format and this framework's native chunks."""
+    import glob as _glob
+    import pickle
+
+    if isinstance(paths, str):
+        paths = paths.split(",")
+    files = []
+    for p in paths:
+        files.extend(sorted(_glob.glob(p)) or [p])
+
+    def reader():
+        for p in files:
+            for rec in _file_records(p):
+                yield pickle.loads(rec)
+
+    return buffered(reader, buf_size)
+
+
 def map_readers(func, *readers):
     """(decorator.py:26) new reader yielding func over outputs of readers."""
 

@@ -179,8 +179,10 @@ def addto(*inputs, name=None, act="", bias=False):
     return _add("addto", inputs, name=name, act=act, bias=bias)
 
 
-def concat(*inputs, name=None):
-    return _add("concat", inputs, name=name)
+def concat(*inputs, name=None, act="", bias=False):
+    # bias defaults OFF (reference concat_layer bias_attr=False); the
+    # v1 façade enables it for ConcatenateLayer2-style biased concats
+    return _add("concat", inputs, name=name, act=act, bias=bias)
 
 
 def cos_sim(a, b, scale=1.0, size=1, name=None):
@@ -222,19 +224,25 @@ def mixed(size, inputs, name=None, act="", bias=True):
         # extra-output refs ('x@state') defer to MixedLayer.build
         g = current()
         for ic in ins:
-            try:
-                src_lc = g.conf.layer(ic.name)
-            except KeyError:
-                continue
-            if src_lc.type in _SIZE_AT_BUILD_ONLY:
-                # conv/pool-family LayerConf.size holds num_filters,
-                # not the flat width — only their build() knows the
-                # real size; leave 0 for MixedLayer.build to resolve
-                continue
-            inferred = mixed_proj_size(
-                ic.attrs.get("proj", "full_matrix"), src_lc.size,
-                ic.attrs
-            )
+            # an edge may carry its own declared width (a projection's
+            # size=, or conv_operator's parse-time output size) — that
+            # wins over source-layer inference
+            inferred = ic.attrs.get("proj_size")
+            if not inferred:
+                try:
+                    src_lc = g.conf.layer(ic.name)
+                except KeyError:
+                    continue
+                if src_lc.type in _SIZE_AT_BUILD_ONLY:
+                    # conv/pool-family LayerConf.size holds
+                    # num_filters, not the flat width — only their
+                    # build() knows the real size; leave 0 for
+                    # MixedLayer.build to resolve
+                    continue
+                inferred = mixed_proj_size(
+                    ic.attrs.get("proj", "full_matrix"), src_lc.size,
+                    ic.attrs
+                )
             if inferred:
                 size = inferred
                 break
@@ -260,11 +268,14 @@ def mixed_proj_size(proj, in_size, attrs):
     MixedLayer.build."""
     if proj in ("identity", "dotmul"):
         return in_size
+    if proj == "slice":
+        return sum(e - b for b, e in attrs["slices"])
     if proj == "context":
         return in_size * attrs["context_length"]
-    if proj in ("full_matrix", "trans_full_matrix"):
+    if proj in ("full_matrix", "trans_full_matrix", "table"):
         # a projection may declare its own output width
-        # (full_matrix_projection(size=...) under a sizeless mixed)
+        # (full_matrix_projection(size=...) / table_projection(size=...)
+        # under a sizeless mixed)
         return attrs.get("proj_size") or None
     return None
 

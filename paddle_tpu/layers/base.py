@@ -140,6 +140,10 @@ def init_parameter(key: jax.Array, pc: ParameterConf, dtype=jnp.float32):
     randomize(): normal with std 1/sqrt(fan_in) for weights, zeros for
     biases/1-D unless initial_std is set)."""
     dims = tuple(pc.dims)
+    if pc.initializer is not None:
+        # user callback name -> ndarray (v2 ParameterAttribute
+        # initializer; reference parameters.py __initialize_with__)
+        return jnp.asarray(pc.initializer(pc.name), dtype).reshape(dims)
     if pc.initial_strategy == "zero":
         return jnp.zeros(dims, dtype)
     if pc.initial_strategy == "constant":

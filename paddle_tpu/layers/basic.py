@@ -142,13 +142,20 @@ class ConcatLayer(Layer):
             all(len(s.dim) == 3 for s in in_specs)
             and len({s.dim[:2] for s in in_specs}) == 1
         )
+        pcs = {}
         if self._image:
             h, w = in_specs[0].dim[:2]
             c = sum(s.dim[2] for s in in_specs)
             self._in_dims = [s.dim for s in in_specs]
-            return Spec(dim=(h, w, c), is_seq=seq), {}
+            b = self.bias_conf((h * w * c,))
+            if b is not None:
+                pcs["b"] = b
+            return Spec(dim=(h, w, c), is_seq=seq), pcs
         tot = sum(s.size for s in in_specs)
-        return Spec(dim=(tot,), is_seq=seq), {}
+        b = self.bias_conf((tot,))
+        if b is not None:
+            pcs["b"] = b
+        return Spec(dim=(tot,), is_seq=seq), pcs
 
     def forward(self, params, inputs, ctx):
         flat = []
@@ -164,6 +171,9 @@ class ConcatLayer(Layer):
                 x = x.reshape(x.shape[:lead] + (-1,))
             flat.append(x)
         y = jnp.concatenate(flat, axis=-1)
+        if "b" in params:
+            b = params["b"]
+            y = y + (b.reshape(y.shape[-3:]) if self._image else b)
         y = self.apply_activation_and_dropout(y, ctx, seq_lens)
         return Arg(value=y, seq_lens=seq_lens)
 
@@ -303,6 +313,17 @@ class MixedLayer(Layer):
                 pcs[f"w{i}"] = self.weight_conf(i, (1,))
             elif proj == "identity":
                 assert s.size == out, f"identity proj size mismatch on {self.name}"
+            elif proj == "slice":
+                # SliceProjection.cpp: concat of [start, end) slices
+                tot = sum(e - b for b, e in ic.attrs["slices"])
+                assert tot == out, (
+                    f"slice proj on {self.name}: slices sum to {tot}, "
+                    f"layer is {out} wide"
+                )
+                for b_, e_ in ic.attrs["slices"]:
+                    assert e_ <= s.size, (
+                        f"slice ({b_}, {e_}) beyond input width {s.size}"
+                    )
             elif proj == "context":
                 # ContextProjection.h:18-43: concat context_length
                 # neighboring timesteps starting at offset context_start
@@ -313,9 +334,26 @@ class MixedLayer(Layer):
                 assert s.is_seq, "context projection needs a sequence input"
             else:
                 raise KeyError(f"unknown projection {proj!r}")
-        b = self.bias_conf((out,))
+        # conv projections share the bias PER FILTER
+        # (config_parser.py:2984: shared_biases=True, bias_size =
+        # sum of the projections' filter counts)
+        conv_bias = [
+            ic.attrs.get("conv_bias") for ic in self.conf.inputs
+        ]
+        self._shared_bias = bool(conv_bias and conv_bias[0])
+        bias_width = (
+            sum(cb or 0 for cb in conv_bias)
+            if self._shared_bias
+            else out
+        )
+        b = self.bias_conf((bias_width,))
         if b is not None:
             pcs["b"] = b
+        if self._shared_bias and len(in_specs[0].dim) == 3:
+            # a mixed over conv projections keeps the conv's spatial
+            # shape (reference ConvProjection output) so a downstream
+            # concat merges CHANNELS, matching a concat of conv layers
+            return Spec(dim=in_specs[0].dim, is_seq=seq), pcs
         return Spec(dim=(out,), is_seq=seq), pcs
 
     def forward(self, params, inputs, ctx):
@@ -337,6 +375,13 @@ class MixedLayer(Layer):
                 t = a.value * params[f"w{i}"]
             elif proj == "scaling":
                 t = a.value * params[f"w{i}"][0]
+            elif proj == "slice":
+                lead = 2 if a.is_seq else 1
+                xs = a.value.reshape(a.value.shape[:lead] + (-1,))
+                t = jnp.concatenate(
+                    [xs[..., b_:e_] for b_, e_ in ic.attrs["slices"]],
+                    axis=-1,
+                )
             elif proj == "context":
                 from paddle_tpu.ops.sequence_ops import seq_shift
 
@@ -352,7 +397,16 @@ class MixedLayer(Layer):
                 )
             y = t if y is None else y + t
         if "b" in params:
-            y = y + params["b"]
+            b = params["b"]
+            if (
+                getattr(self, "_shared_bias", False)
+                and y.shape[-1] != b.shape[0]
+            ):
+                # per-filter bias over an NHWC-flattened conv
+                # output: channels are the fastest axis, so
+                # tile over spatial
+                b = jnp.tile(b, y.shape[-1] // b.shape[0])
+            y = y + b
         y = self.apply_activation_and_dropout(y, ctx, seq_lens)
         return Arg(value=y, seq_lens=seq_lens)
 

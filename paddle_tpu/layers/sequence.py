@@ -327,10 +327,23 @@ class CropLayer(Layer):
 
 @LAYERS.register("rotate")
 class RotateLayer(Layer):
-    """Rotate the [H,W] view 90° CCW (gserver/layers/RotateLayer.cpp).
+    """Rotate each [H,W] channel plane 90° CLOCKWISE
+    (gserver/layers/RotateLayer.cpp: y(j,i,:) = x(M-i-1,j,:) with
+    Matrix::rotate clockWise=true; channels = size/(h*w)).
     attrs: height, width."""
 
     def build(self, in_specs):
+        s = in_specs[0]
+        a = self.conf.attrs
+        h, w = a["height"], a["width"]
+        size = 1
+        for d in s.dim:
+            size *= int(d)
+        if size % (h * w):
+            raise ValueError(
+                f"rotate: input size {size} not divisible by "
+                f"height*width {h}x{w}"
+            )
         return in_specs[0], {}
 
     def forward(self, params, inputs, ctx):
@@ -339,9 +352,12 @@ class RotateLayer(Layer):
         h, w = a["height"], a["width"]
         x = arg.value
         lead = x.shape[:-1]
-        y = x.reshape(lead + (h, w))
-        y = jnp.flip(y.swapaxes(-1, -2), axis=-2)
-        return arg.with_value(y.reshape(lead + (h * w,)))
+        size = x.shape[-1]
+        c = size // (h * w)
+        y = x.reshape(lead + (c, h, w))
+        # clockwise: y[a,b] = x[h-1-b, a]  (flip rows, then transpose)
+        y = jnp.flip(y, axis=-2).swapaxes(-1, -2)
+        return arg.with_value(y.reshape(lead + (size,)))
 
 
 @LAYERS.register("subseq", "sub_seq")

@@ -1,0 +1,154 @@
+"""The reference's OWN gserver NetworkCompare configs run UNMODIFIED —
+gserver/tests/test_NetworkCompare.cpp's seven fixed pairs
+(compareNetwork: same parameters into two differently-written configs,
+same random input, outputs and gradients must match). The configs are
+executed from /root/reference exactly as written; parameters are
+shared by declaration order (shape-checked), and both forward outputs
+and parameter/input gradients are compared."""
+
+import pathlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.compat.config_parser import parse_config
+from paddle_tpu.core.arg import Arg, id_arg
+from paddle_tpu.network import Network
+
+REF = "/root/reference"
+CFG = f"{REF}/paddle/gserver/tests"
+
+pytestmark = pytest.mark.skipif(
+    not pathlib.Path(CFG).exists(), reason="reference tree not mounted"
+)
+
+
+def _build(path, ids=False):
+    tc = parse_config(path)
+    model = tc.model
+    if ids:
+        lc = model.layer("input")
+        lc.attrs["is_ids"] = True
+        # the façade defaults id slots to sequences; this battery
+        # feeds one id per example
+        lc.attrs["is_seq"] = False
+    return Network(model)
+
+
+def _share_params(na, nb, key):
+    """Init A, then map A's params onto B by declaration order with a
+    shape check — the reference copies parameter VALUES between the two
+    machines (calcGradient under one seed)."""
+    pa = na.init_params(key)
+    pb = nb.init_params(key)
+    ka, kb = list(pa), list(pb)
+    assert len(ka) == len(kb), (ka, kb)
+    shapes_a = [tuple(pa[k].shape) for k in ka]
+    shapes_b = [tuple(pb[k].shape) for k in kb]
+    assert shapes_a == shapes_b, (shapes_a, shapes_b)
+    return pa, {k2: pa[k1] for k1, k2 in zip(ka, kb)}
+
+
+def _outputs_and_grads(net, params, feed):
+    names = list(net.conf.output_layer_names)
+
+    def loss_fn(p, x):
+        f = dict(feed)
+        if x is not None:
+            f["input"] = Arg(value=x)
+        outs, _ = net.forward(p, f)
+        tot = 0.0
+        vals = []
+        for n in names:
+            v = outs[n].value.astype(jnp.float32)
+            vals.append(v)
+            # a nonuniform weighting so gradient comparison is not
+            # blind to permutations the plain sum would cancel
+            w = jnp.arange(1, v.size + 1, dtype=jnp.float32).reshape(
+                v.shape
+            )
+            tot = tot + jnp.sum(v * jnp.cos(w))
+        return tot, vals
+
+    x = feed["input"].value if feed["input"].value is not None else None
+    (tot, vals), grads = jax.value_and_grad(
+        loss_fn, argnums=(0, 1) if x is not None else 0, has_aux=True
+    )(params, x)
+    if x is not None:
+        pgrads, xgrad = grads
+    else:
+        pgrads, xgrad = grads, None
+    return vals, pgrads, xgrad
+
+
+def _compare(name_a, name_b, dim, ids=False, vocab=0, batch=4,
+             atol=2e-5):
+    na = _build(f"{CFG}/{name_a}", ids=ids)
+    nb = _build(f"{CFG}/{name_b}", ids=ids)
+    pa, pb = _share_params(na, nb, jax.random.key(11))
+    rng = np.random.default_rng(5)
+    if ids:
+        feed = {
+            "input": id_arg(
+                rng.integers(0, vocab, size=(batch,)).astype(np.int32)
+            )
+        }
+    else:
+        feed = {
+            "input": Arg(
+                value=rng.standard_normal((batch, dim)).astype(
+                    np.float32
+                )
+            )
+        }
+    va, ga, xa = _outputs_and_grads(na, pa, feed)
+    vb, gb, xb = _outputs_and_grads(nb, pb, feed)
+    assert len(va) == len(vb)
+    for a, b in zip(va, vb):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=atol
+        )
+    ka, kb = list(ga), list(gb)
+    for k1, k2 in zip(ka, kb):
+        np.testing.assert_allclose(
+            np.asarray(ga[k1]), np.asarray(gb[k2]), atol=atol,
+            err_msg=f"param grad {k1} vs {k2}",
+        )
+    if xa is not None:
+        np.testing.assert_allclose(
+            np.asarray(xa), np.asarray(xb), atol=atol,
+            err_msg="input grad",
+        )
+
+
+def test_compare_concat_dotmul():
+    _compare("concat_dotmul_a.conf", "concat_dotmul_b.conf", 1000)
+
+
+def test_compare_concat_fullmatrix():
+    _compare("concat_fullmatrix_a.conf", "concat_fullmatrix_b.conf", 100)
+
+
+def test_compare_concat_table():
+    _compare(
+        "concat_table_a.conf", "concat_table_b.conf", 10000,
+        ids=True, vocab=10000,
+    )
+
+
+def test_compare_concat_slice():
+    _compare("concat_slice_a.conf", "concat_slice_b.conf", 8 * 16 * 16)
+
+
+def test_compare_img_pool():
+    _compare("img_pool_a.conf", "img_pool_b.conf", 8 * 16 * 16)
+
+
+def test_compare_img_conv():
+    _compare("img_conv_a.conf", "img_conv_b.conf", 8 * 16 * 16)
+
+
+def test_compare_img_conv2_cudnn_vs_exconv():
+    _compare("img_conv_cudnn.py", "img_conv_exconv.py", 8 * 16 * 16)

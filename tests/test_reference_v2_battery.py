@@ -1,0 +1,167 @@
+"""The reference's OWN v2 Python unit-test battery runs against the
+compat surface — the v2 analogue of the config-parser battery
+(`test_reference_configs_r5.py`). Files from
+/root/reference/python/paddle/v2/tests and
+/root/reference/python/paddle/trainer_config_helpers/tests, executed
+UNMODIFIED via compat/py2run's mechanical py2->py3 load-time
+conversion; every unittest.TestCase they define is run and must pass.
+
+Battery (reference CMakeLists:
+python/paddle/v2/tests/CMakeLists.txt):
+  - test_layer.py         (the whole v2 layer/projection/operator surface)
+  - test_op.py            (paddle.v2.op math + layer arithmetic)
+  - test_topology.py      (Topology data_type/get_layer/proto)
+  - test_rnn_layer.py     (v1 recurrent_group vs v2 parse diff)
+  - test_parameters.py    (ParameterConfig protos + tar round trips)
+  - test_data_feeder.py   (DataFeeder -> Arguments slot surface)
+  - test_image.py         (image utils on cat.jpg)
+  - trainer_config_helpers/tests/layers_test.py  (parse+serialize)
+  - trainer_config_helpers/tests/test_reset_hook.py (parse determinism)
+"""
+
+import os
+import pathlib
+import sys
+import unittest
+
+import pytest
+
+from paddle_tpu.compat.py2run import to_py3
+
+REF = "/root/reference"
+V2T = f"{REF}/python/paddle/v2/tests"
+TCH = f"{REF}/python/paddle"  # cwd for trainer_config_helpers tests
+
+pytestmark = pytest.mark.skipif(
+    not pathlib.Path(REF).exists(), reason="reference tree not mounted"
+)
+
+
+def _run_unittest_file(path, transform=None, cwd=None):
+    """Exec a reference py2 unittest file (converted in memory, file
+    untouched) and run every TestCase it defines."""
+    from paddle.v2 import config_base
+
+    config_base.reset()
+    with open(path) as f:
+        src = to_py3(f.read(), path, force=True)
+    if transform:
+        src = transform(src)
+    g = {
+        "__name__": "ref_battery",
+        "__file__": os.path.abspath(path),
+        "xrange": range,
+    }
+    old_cwd = os.getcwd()
+    old_path = list(sys.path)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+    if cwd:
+        os.chdir(cwd)
+    try:
+        exec(compile(src, path, "exec"), g)
+        cases = [
+            v
+            for v in g.values()
+            if isinstance(v, type)
+            and issubclass(v, unittest.TestCase)
+            and v is not unittest.TestCase
+        ]
+        assert cases, f"{path}: no TestCases found"
+        suite = unittest.TestSuite(
+            unittest.defaultTestLoader.loadTestsFromTestCase(c)
+            for c in cases
+        )
+        res = unittest.TestResult()
+        suite.run(res)
+        msgs = [
+            f"{t}: {tb.splitlines()[-1]}"
+            for t, tb in res.failures + res.errors
+        ]
+        assert res.wasSuccessful(), (
+            f"{path}: {len(msgs)} failed of {res.testsRun}: " + "; ".join(msgs)
+        )
+        assert res.testsRun > 0, path
+        return res
+    finally:
+        os.chdir(old_cwd)
+        sys.path[:] = old_path
+        config_base.reset()
+
+
+def test_ref_v2_test_layer():
+    _run_unittest_file(f"{V2T}/test_layer.py")
+
+
+def test_ref_v2_test_op():
+    _run_unittest_file(f"{V2T}/test_op.py")
+
+
+def test_ref_v2_test_topology():
+    _run_unittest_file(f"{V2T}/test_topology.py")
+
+
+def test_ref_v2_test_rnn_layer():
+    _run_unittest_file(f"{V2T}/test_rnn_layer.py")
+
+
+def test_ref_v2_test_parameters():
+    # py2's cStringIO held BYTES; lib2to3's imports fixer maps it to
+    # io.StringIO, but the tar codec needs the py3 bytes equivalent
+    _run_unittest_file(
+        f"{V2T}/test_parameters.py",
+        transform=lambda s: s.replace("io.StringIO()", "io.BytesIO()"),
+    )
+
+
+def test_ref_v2_test_data_feeder():
+    _run_unittest_file(f"{V2T}/test_data_feeder.py")
+
+
+def test_ref_v2_test_image():
+    # cat.jpg is loaded relative to the test file
+    _run_unittest_file(f"{V2T}/test_image.py", cwd=V2T)
+
+
+def test_ref_v2_reader_creator_test():
+    _run_unittest_file(
+        f"{REF}/python/paddle/v2/reader/tests/creator_test.py",
+        # py2 unittest spelling of assertCountEqual
+        transform=lambda s: s.replace(
+            "assertItemsEqual", "assertCountEqual"
+        ),
+    )
+
+
+def test_ref_v2_reader_decorator_test():
+    _run_unittest_file(
+        f"{REF}/python/paddle/v2/reader/tests/decorator_test.py"
+    )
+
+
+def test_ref_v2_plot_test_ploter():
+    _run_unittest_file(f"{REF}/python/paddle/v2/plot/tests/test_ploter.py")
+
+
+def test_ref_tch_layers_test():
+    """trainer_config_helpers/tests/layers_test.py — runs as __main__:
+    parse_config_and_serialize over layers_test_config.py (cwd-relative
+    path, reference CMakeLists runs it from python/paddle)."""
+    from paddle.v2 import config_base
+    from paddle_tpu.compat.py2run import run_py2_script
+
+    config_base.reset()
+    old = os.getcwd()
+    os.chdir(TCH)
+    try:
+        run_py2_script(
+            f"{TCH}/trainer_config_helpers/tests/layers_test.py"
+        )
+    finally:
+        os.chdir(old)
+        config_base.reset()
+
+
+def test_ref_tch_reset_hook():
+    _run_unittest_file(
+        f"{TCH}/trainer_config_helpers/tests/test_reset_hook.py", cwd=TCH
+    )
