@@ -176,10 +176,14 @@ class SequenceGenerator:
     """Beam-search generation front-end (api/SequenceGenerator.cpp):
     wraps BeamSearchDecoder, returning id sequences per input."""
 
-    def __init__(self, decoder, params: dict, dict_list=None):
+    def __init__(self, decoder, params: dict, dict_list=None,
+                 num_results=None):
         self.decoder = decoder
         self.params = params
         self.dict_list = dict_list
+        # beams returned per sample (v1 num_results_per_sample;
+        # None = all beam_size beams)
+        self.num_results = num_results
 
     def setBeamSize(self, k: int):
         self.decoder.k = k
@@ -209,9 +213,10 @@ class SequenceGenerator:
         )
         seqs, lens = np.asarray(seqs), np.asarray(lens)
         out = []
+        n_keep = self.num_results or seqs.shape[1]
         for b in range(seqs.shape[0]):
             beams = []
-            for k in range(seqs.shape[1]):
+            for k in range(min(n_keep, seqs.shape[1])):
                 ids = seqs[b, k, : lens[b, k]].tolist()
                 if self.dict_list is not None:
                     beams.append(
@@ -221,3 +226,55 @@ class SequenceGenerator:
                     beams.append(ids)
             out.append(beams)
         return out
+
+
+def create_config_generator(model_conf, params, group_name=None):
+    """SequenceGenerator for a GENERATING v1 config — the
+    `beam_search(...)` declaration parsed into a
+    SubModelConf(is_generating=True) (trainer_config_helpers
+    beam_search:3893; executed upstream by
+    RecurrentGradientMachine::generateSequence,
+    RecurrentGradientMachine.h:307). The user step runs per decode
+    step; the GeneratedInput position receives the `embedding_name`
+    lookup of the previously generated word."""
+    from paddle_tpu import dsl
+    from paddle_tpu.beam_search import BeamSearchDecoder
+    from paddle_tpu.core.config import ParameterConf
+
+    gens = [
+        sm for sm in model_conf.sub_models
+        if sm.is_generating
+        and (group_name is None or sm.name == group_name)
+    ]
+    if not gens:
+        raise ValueError("config declares no generating beam_search group")
+    a = gens[0].attrs
+    static_names = list(a["static_layer_names"])
+    by_name = {lc.name: lc for lc in model_conf.layers}
+    static_sizes = [by_name[n].size for n in static_names]
+
+    def adapted_step(word, *statics):
+        emb = dsl.embedding(
+            word,
+            size=a["embedding_size"],
+            vocab_size=a["gen_size"],
+            param=ParameterConf(name=a["embedding_name"]),
+        )
+        args = list(statics)
+        args.insert(a["gen_pos"], emb)
+        return a["step"](*args)
+
+    dec = BeamSearchDecoder(
+        adapted_step,
+        n_static=len(static_names),
+        bos_id=a["bos_id"],
+        eos_id=a["eos_id"],
+        beam_size=a["beam_size"],
+        max_length=a["max_length"],
+        static_sizes=static_sizes,
+    )
+    return (
+        SequenceGenerator(dec, params, num_results=a["num_results"]),
+        static_names,
+        a,
+    )

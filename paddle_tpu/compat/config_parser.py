@@ -26,6 +26,11 @@ __all__ = [
     "get_config_arg",
     "settings",
     "define_py_data_sources2",
+    "TrainData",
+    "TestData",
+    "SimpleData",
+    "ProtoData",
+    "read_simple_data",
     "outputs",
     "inputs",
     "default_device",
@@ -86,6 +91,8 @@ class _ParseCtx:
         self.args = args
         self.opt = OptimizationConf()
         self.data_sources: Optional[DataSources] = None
+        self.train_data: Optional[dict] = None
+        self.test_data: Optional[dict] = None
         self.outputs: list = []
         self.inputs: list = []
         self.evaluators: list = []
@@ -307,6 +314,69 @@ class DataSources:
 
     def test_reader(self):
         return self._reader(self.test_list)
+
+
+# ---- v1 data declarations (config_parser.py TrainData/TestData;
+#      SimpleData:986, ProtoData — the trainer-test configs' forms) ----
+
+def SimpleData(files=None, feat_dim=None, context_len=None,
+               buffer_capacity=None, **_):
+    """Text samples 'label f1 .. fD' listed by a file-list
+    (SimpleDataProvider, gserver/dataproviders/DataProvider.cpp:395)."""
+    return {"type": "simple", "files": files, "feat_dim": feat_dim,
+            "context_len": context_len or 0}
+
+
+def ProtoData(files=None, type=None, **kw):
+    """DataFormat.proto binary sample files listed by a file-list
+    (ProtoDataProvider); decoded by data/proto_provider.py."""
+    return {"type": type or "proto", "files": files, **kw}
+
+
+def TrainData(decl, async_load_data=None, **_):
+    ctx = _ctx()
+    assert ctx is not None, "TrainData() outside parse_config"
+    ctx.train_data = decl
+
+
+def TestData(decl, async_load_data=None, **_):
+    ctx = _ctx()
+    assert ctx is not None, "TestData() outside parse_config"
+    ctx.test_data = decl
+
+
+def read_simple_data(filelist: str, feat_dim: int, context_len: int = 0):
+    """Load every file in a SimpleData file-list: returns
+    (features [N, feat_dim] float32, labels [N] int32). Line format is
+    'label f1 .. fD' (DataProvider.cpp:404: label first). Context
+    windows (context_len > 0) are not implemented — fail loudly rather
+    than train on un-contextualized features."""
+    import numpy as np
+
+    if context_len:
+        raise NotImplementedError(
+            "SimpleData context_len > 0 (context-window expansion) is "
+            "not supported; expand windows in the provider instead"
+        )
+
+    feats, labels = [], []
+    for path in open(filelist).read().splitlines():
+        path = path.strip()
+        if not path:
+            continue
+        for line in open(path).read().splitlines():
+            pieces = line.split(" ")
+            if len(pieces) != feat_dim + 1:
+                raise ValueError(
+                    f"{path}: got {len(pieces) - 1} features, "
+                    f"config says {feat_dim}"
+                )
+            labels.append(int(pieces[0]))
+            feats.append([float(p) for p in pieces[1:]])
+    return (
+        np.asarray(feats, np.float32),
+        np.asarray(labels, np.int32),
+    )
 
 
 def define_py_data_sources2(train_list=None, test_list=None, module="",
@@ -543,6 +613,9 @@ class TrainerConfig:
     data_sources: Optional[DataSources]
     args: dict
     evaluators: list = field(default_factory=list)
+    # v1 TrainData/TestData declarations (SimpleData/ProtoData dicts)
+    train_data: Optional[dict] = None
+    test_data: Optional[dict] = None
 
     # -- the reference TrainerConfig proto surface the api drivers use
     #    (proto/TrainerConfig.proto; v1_api_demo/quick_start/api_train.py:80-84)
@@ -631,6 +704,7 @@ def parse_config(config_file, config_args="") -> TrainerConfig:
     return TrainerConfig(
         model=conf, opt=ctx.opt, data_sources=ctx.data_sources,
         args=ctx.args, evaluators=ctx.evaluators,
+        train_data=ctx.train_data, test_data=ctx.test_data,
     )
 
 

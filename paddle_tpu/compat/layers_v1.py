@@ -140,6 +140,9 @@ __all__ = [
     "StaticInput",
     "SubsequenceInput",
     "recurrent_group",
+    "beam_search",
+    "GeneratedInput",
+    "BaseGeneratedInput",
     # activations (attrs-style classes)
     "LinearActivation",
     "ReluActivation",
@@ -1371,6 +1374,84 @@ def recurrent_group(step, input, name=None, reverse=False, **_):
            for x in _many(input)]
     return dsl.recurrent_group(step, ins, name=name,
                                reversed=reverse)
+
+
+class BaseGeneratedInput:
+    """(layers.py BaseGeneratedInput)."""
+
+
+class GeneratedInput(BaseGeneratedInput):
+    """(layers.py:3744 GeneratedInput) — the beam_search in-link whose
+    value at step t is the `embedding_name` embedding of the word the
+    beam generated at t-1 (bos at t=0)."""
+
+    def __init__(self, size, embedding_name, embedding_size, **_):
+        self.size = size
+        self.embedding_name = embedding_name
+        self.embedding_size = embedding_size
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size=1,
+                max_length=500, name=None,
+                num_results_per_sample=None, **_):
+    """(layers.py:3893 beam_search) — declare a GENERATING recurrent
+    group: `step` runs per decode step over beam candidates; the
+    GeneratedInput position receives the embedded previously-generated
+    word; other inputs are per-sequence statics. Recorded as a
+    SubModelConf(is_generating=True) executed by
+    api.SequenceGenerator (RecurrentGradientMachine.h:307
+    generateSequence + beamSearch)."""
+    from paddle_tpu.core.config import InputConf, LayerConf, SubModelConf
+
+    ins = _many(input)
+    gen_pos = [
+        i for i, x in enumerate(ins)
+        if isinstance(x, BaseGeneratedInput)
+    ]
+    assert len(gen_pos) == 1, (
+        "beam_search needs exactly one GeneratedInput among its inputs"
+    )
+    gi = ins[gen_pos[0]]
+    statics = []
+    for i, x in enumerate(ins):
+        if i == gen_pos[0]:
+            continue
+        # unwrap the v1/dsl StaticInput wrappers to the layer ref
+        x = getattr(x, "input", x)
+        x = getattr(x, "ref", x)
+        statics.append(_one(x))
+    g = dsl.current()
+    gname = name or g.uniq("beam_search")
+    out = g.add(
+        LayerConf(
+            name="__beam_search_predict__", type="gen_output",
+            size=gi.size,
+            inputs=[InputConf(name=s.name) for s in statics],
+            attrs={"dim": (1,), "is_seq": True, "is_ids": True},
+        )
+    )
+    g.conf.sub_models.append(
+        SubModelConf(
+            name=gname,
+            layer_names=["__beam_search_predict__"],
+            is_generating=True,
+            attrs={
+                "step": step,
+                "gen_pos": gen_pos[0],
+                "gen_size": gi.size,
+                "embedding_name": gi.embedding_name,
+                "embedding_size": gi.embedding_size,
+                "static_layer_names": [s.name for s in statics],
+                "bos_id": bos_id,
+                "eos_id": eos_id,
+                "beam_size": beam_size,
+                "max_length": max_length,
+                "num_results": num_results_per_sample or beam_size,
+                "out_layer": "__beam_search_predict__",
+            },
+        )
+    )
+    return out
 
 
 def small_vgg(input_image, num_channels, num_classes, **_):

@@ -360,6 +360,26 @@ class RotateLayer(Layer):
         return arg.with_value(y.reshape(lead + (size,)))
 
 
+@LAYERS.register("gen_output")
+class GenOutputLayer(Layer):
+    """Placeholder for the id sequences a generating beam-search group
+    emits (the v1 '__beam_search_predict__' layer,
+    trainer_config_helpers/layers.py:3757; executed by
+    RecurrentGradientMachine::generateSequence,
+    RecurrentGradientMachine.h:307). Generation runs through
+    api.SequenceGenerator / paddle_tpu.beam_search — this layer only
+    anchors the graph so outputs()/Topology see the generator."""
+
+    def build(self, in_specs):
+        return Spec(dim=(1,), is_seq=True, is_ids=True), {}
+
+    def forward(self, params, inputs, ctx):
+        raise RuntimeError(
+            f"{self.name}: generated sequences come from "
+            "api.SequenceGenerator (beam search), not Network.forward"
+        )
+
+
 @LAYERS.register("subseq", "sub_seq")
 class SubSequenceLayer(Layer):
     """Take a per-example sub-span of each sequence given dynamic offset

@@ -103,6 +103,40 @@ def load_pass(save_dir: str, pass_id: int = -1):
     return params, opt_state, state, meta
 
 
+def load_parameter_file(path: str, dims=None) -> "np.ndarray":
+    """One parameter in the reference's raw binary format
+    (parameter/Parameter.cpp Parameter::load: a 16-byte
+    {version=0, valueSize=4, size} header + float32 payload — also the
+    per-member format inside v2 tars). `dims` reshapes the flat
+    vector."""
+    import struct
+
+    with open(path, "rb") as f:
+        head = f.read(16)
+        version, vsize, n = struct.unpack(_TAR_HEADER, head)
+        if version != 0 or vsize != 4:
+            raise ValueError(
+                f"{path}: unsupported parameter header "
+                f"version={version} valueSize={vsize}"
+            )
+        arr = np.frombuffer(f.read(4 * n), np.float32).copy()
+    if arr.size != n:
+        raise ValueError(f"{path}: truncated parameter payload")
+    return arr.reshape(dims) if dims is not None else arr
+
+
+def load_parameter_dir(model_dir: str, param_confs: dict) -> dict:
+    """A reference model directory (trainer/ParamUtil.h loadParameters:
+    one raw binary file per parameter, named by parameter) -> params
+    dict shaped by `param_confs`."""
+    params = {}
+    for name, pc in param_confs.items():
+        params[name] = load_parameter_file(
+            os.path.join(model_dir, name), tuple(pc.dims)
+        )
+    return params
+
+
 def merge_model(path: str, model_conf, params: dict, state=None):
     """Single-file deployable: config JSON + weights (MergeModel.cpp /
     capi merged model analogue)."""

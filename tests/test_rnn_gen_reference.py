@@ -1,0 +1,104 @@
+"""The reference's recurrent-machine GENERATION test on its own
+artifacts: `sample_trainer_rnn_gen.conf` parses UNMODIFIED (v1
+beam_search + GeneratedInput + StaticInput), the pretrained binary
+parameters in `rnn_gen_test_model_dir/t1` load through the reference
+Parameter::load wire format, and beam-search decoding reproduces the
+expected outputs byte-for-float — mirroring
+trainer/tests/test_recurrent_machine_generation.cpp (testGen nobeam +
+beam arms; checkOutput compares the float stream of the dump file)."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+from paddle_tpu.api import create_config_generator
+from paddle_tpu.compat.config_parser import parse_config
+from paddle_tpu.core.arg import Arg
+from paddle_tpu.trainer.checkpoint import (
+    load_parameter_dir,
+    load_parameter_file,
+)
+
+REF = "/root/reference/paddle/trainer/tests"
+MODEL = f"{REF}/rnn_gen_test_model_dir"
+
+pytestmark = pytest.mark.skipif(
+    not pathlib.Path(REF).exists(), reason="reference tree not mounted"
+)
+
+
+def _floats(text: str):
+    return [float(t) for t in text.split()]
+
+
+def _generate(beam_search_flag: bool):
+    tc = parse_config(
+        f"{REF}/sample_trainer_rnn_gen.conf",
+        {"beam_search": "1"} if beam_search_flag else {"beam_search": ""},
+    )
+    gen, static_names, attrs = create_config_generator(tc.model, None)
+    # decoder params in the reference model dir (ParamUtil layout:
+    # one raw binary file per parameter)
+    pcs = gen.decoder.param_confs(
+        [Arg(value=np.zeros((1, 2), np.float32))]
+    )
+    assert set(pcs) == {"wordvec", "transtable"}, pcs
+    gen.params = load_parameter_dir(f"{MODEL}/t1", pcs)
+    # the test driver's feed (test_recurrent_machine_generation.cpp
+    # prepareInArgs): 15 samples, dummy static decides the batch
+    b = 15
+    statics = [Arg(value=np.zeros((b, 2), np.float32))]
+    assert static_names == ["dummy_data_input"]
+    results = gen.generate(statics)
+    return results, attrs
+
+
+def test_nobeam_matches_reference():
+    tc_results, attrs = _generate(False)
+    assert attrs["beam_size"] == 1 and attrs["num_results"] == 1
+    lines = []
+    for i, beams in enumerate(tc_results):
+        ids = beams[0]
+        lines.append(f"{i}\t " + " ".join(str(x) for x in ids))
+    got = _floats("\n".join(lines))
+    exp = _floats(open(f"{MODEL}/r1.test.nobeam").read())
+    assert got == exp, (got[:12], exp[:12])
+
+
+def test_beam_matches_reference():
+    tc = parse_config(
+        f"{REF}/sample_trainer_rnn_gen.conf", {"beam_search": "1"}
+    )
+    gen, static_names, attrs = create_config_generator(tc.model, None)
+    assert attrs["beam_size"] == 2 and attrs["num_results"] == 2
+    pcs = gen.decoder.param_confs(
+        [Arg(value=np.zeros((1, 2), np.float32))]
+    )
+    gen.params = load_parameter_dir(f"{MODEL}/t1", pcs)
+    b = 15
+    seqs, lens, scores = gen.decoder.generate(
+        gen.params, [Arg(value=np.zeros((b, 2), np.float32))]
+    )
+    seqs, lens, scores = map(np.asarray, (seqs, lens, scores))
+    lines = []
+    for i in range(b):
+        lines.append(f"{i}")
+        for k in range(attrs["num_results"]):
+            ids = seqs[i, k, : lens[i, k]].tolist()
+            lines.append(
+                f"{k}\t{scores[i, k]:g}\t "
+                + " ".join(str(x) for x in ids)
+            )
+        lines.append("")
+    got = _floats("\n".join(lines))
+    exp = _floats(open(f"{MODEL}/r1.test.beam").read())
+    assert len(got) == len(exp), (len(got), len(exp))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(exp), atol=1e-5)
+
+
+def test_parameter_file_codec():
+    w = load_parameter_file(f"{MODEL}/t1/wordvec", (5, 5))
+    assert w.shape == (5, 5)
+    # the fixture is an identity-like lookup table
+    assert np.isfinite(w).all()
