@@ -241,16 +241,33 @@ def create_config_generator(model_conf, params, group_name=None):
     from paddle_tpu.beam_search import BeamSearchDecoder
     from paddle_tpu.core.config import ParameterConf
 
-    gens = [
-        sm for sm in model_conf.sub_models
-        if sm.is_generating
-        and (group_name is None or sm.name == group_name)
-    ]
-    if not gens:
+    def _find(conf):
+        for sm in conf.sub_models:
+            if sm.is_generating and (
+                group_name is None or sm.name == group_name
+            ):
+                return sm, conf
+        # a beam_search nested inside an outer recurrent_group's step
+        # (the nested-generation form, sample_trainer_nest_rnn_gen:
+        # each outer subsequence step generates one sequence) — its
+        # statics are per-outer-step values, so the flat decoder runs
+        # with batch = number of outer steps
+        for lc in conf.layers:
+            if lc.type == "recurrent_group":
+                sub = lc.attrs.get("step_conf")
+                if sub is not None:
+                    found = _find(sub)
+                    if found:
+                        return found
+        return None
+
+    found = _find(model_conf)
+    if not found:
         raise ValueError("config declares no generating beam_search group")
-    a = gens[0].attrs
+    gen_sm, host_conf = found
+    a = gen_sm.attrs
     static_names = list(a["static_layer_names"])
-    by_name = {lc.name: lc for lc in model_conf.layers}
+    by_name = {lc.name: lc for lc in host_conf.layers}
     static_sizes = [by_name[n].size for n in static_names]
 
     def adapted_step(word, *statics):

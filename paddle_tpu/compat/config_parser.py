@@ -365,7 +365,7 @@ def read_simple_data(filelist: str, feat_dim: int, context_len: int = 0):
         if not path:
             continue
         for line in open(path).read().splitlines():
-            pieces = line.split(" ")
+            pieces = line.split()
             if len(pieces) != feat_dim + 1:
                 raise ValueError(
                     f"{path}: got {len(pieces) - 1} features, "
@@ -656,6 +656,22 @@ def _parse_args(config_args) -> dict:
     return out
 
 
+def _raw_namespace() -> dict:
+    """The exec namespace the reference injects into raw config files
+    (everything this module exports + the raw Layer/Projection API +
+    the helper-layer surface)."""
+    import paddle_tpu.compat.layers_v1 as _l1
+    import paddle_tpu.compat.raw_config as _raw
+
+    import sys
+
+    me = sys.modules[__name__]
+    ns = {n: getattr(me, n) for n in __all__}
+    ns.update({n: getattr(_l1, n) for n in _l1.__all__})
+    ns.update({n: getattr(_raw, n) for n in _raw.__all__})
+    return ns
+
+
 def parse_config(config_file, config_args="") -> TrainerConfig:
     """Exec a v1 config file (config_parser.py:3724 parse_config).
 
@@ -673,6 +689,12 @@ def parse_config(config_file, config_args="") -> TrainerConfig:
 
     ctx = _ParseCtx(_parse_args(config_args))
     _stack.append(ctx)
+    # a config error inside an open raw RecurrentLayerGroupBegin scope
+    # must not leak its sub-builder / group frame into later parses
+    from paddle_tpu.compat import raw_config as _raw_mod
+
+    dsl_depth = len(dsl._stack)
+    group_depth = len(_raw_mod._group_stack)
     try:
         if callable(config_file):
             with dsl.model() as g:
@@ -685,11 +707,17 @@ def parse_config(config_file, config_args="") -> TrainerConfig:
                 "__name__": "__paddle_config__",
                 "xrange": range,  # py2-era configs
             }
+            # RAW configs (no imports) run inside the reference
+            # parser's own namespace — seed the same surface; a
+            # config's own `from ... import *` still shadows it
+            ns.update(_raw_namespace())
             with dsl.model() as g:
                 exec(code, ns)
         conf = g.conf
     finally:
         _stack.pop()
+        del dsl._stack[dsl_depth:]
+        del _raw_mod._group_stack[group_depth:]
     if ctx.outputs:
         for name in ctx.outputs:
             if name not in conf.output_layer_names:

@@ -646,6 +646,33 @@ def memory(name, size, boot_layer=None, boot_value=0.0):
     return MemoryRef(link, g, record)
 
 
+def group_layer_conf(name, sub, *, parent_inputs, in_links, static_links,
+                     out_links, reversed=False):
+    """The scan-executor LayerConf for a recurrent group — the ONE
+    place the contract lives (consumed by layers/recurrent_group.py);
+    both recurrent_group below and the raw
+    RecurrentLayerGroupBegin/End API build through it."""
+    boot_layers = [
+        m["boot_layer"] for m in sub.memories
+        if m["boot_layer"] is not None
+    ]
+    return LayerConf(
+        name=name,
+        type="recurrent_group",
+        size=0,
+        inputs=[InputConf(n) for n in parent_inputs]
+        + [InputConf(n) for n in boot_layers],
+        attrs={
+            "step_conf": sub.conf,
+            "in_links": list(in_links),
+            "static_links": list(static_links),
+            "memories": sub.memories,
+            "out_links": list(out_links),
+            "reversed": reversed,
+        },
+    )
+
+
 def recurrent_group(step, inputs, name=None, reversed=False):
     """Build a scanned step network. `inputs`: LayerRefs (sequence
     in-links, sliced per step) and/or StaticInput(ref). `step` receives
@@ -694,24 +721,12 @@ def recurrent_group(step, inputs, name=None, reversed=False):
             step_args.append(LayerRef(ln, sub))
         out = step(*step_args)
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
-    boot_layers = [
-        m["boot_layer"] for m in sub.memories if m["boot_layer"] is not None
-    ]
-    lc = LayerConf(
-        name=name,
-        type="recurrent_group",
-        size=0,
-        inputs=[InputConf(r.name) for r in seq_ins]
-        + [InputConf(r.name) for r in stat_ins]
-        + [InputConf(n) for n in boot_layers],
-        attrs={
-            "step_conf": sub.conf,
-            "in_links": in_links,
-            "static_links": static_links,
-            "memories": sub.memories,
-            "out_links": [o.name for o in outs],
-            "reversed": reversed,
-        },
+    lc = group_layer_conf(
+        name, sub,
+        parent_inputs=[r.name for r in seq_ins]
+        + [r.name for r in stat_ins],
+        in_links=in_links, static_links=static_links,
+        out_links=[o.name for o in outs], reversed=reversed,
     )
     ref = parent.add(lc)
     if isinstance(out, (tuple, list)):

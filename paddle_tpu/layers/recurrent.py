@@ -101,6 +101,10 @@ class RecurrentLayer(Layer):
     def build(self, in_specs):
         (s,) = in_specs
         h = self.conf.size
+        if not h:
+            # raw configs omit size; the reference defaults it to the
+            # input width (config_parser RecurrentLayer set_layer_size)
+            h = self.conf.size = s.size
         assert s.size == h, "recurrent layer input must equal size"
         pcs = {"w0": self.weight_conf(0, (h, h))}
         b = self.bias_conf((h,))

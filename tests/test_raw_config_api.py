@@ -1,0 +1,206 @@
+"""The RAW config API (the reference config_parser's Layer/Input/
+Projection/Memory/RecurrentLayerGroup surface, injected into a
+config's exec namespace) — proven on the reference's own raw trainer
+configs: chunking.conf (mixed projections + CRF),
+sample_trainer_config_{rnn,qb_rnn}.conf (raw recurrent layer groups,
+1.45M-word shared embeddings), and
+sample_trainer_config_compare_sparse.conf trained on the reference's
+compare_sparse_data proto-sequence fixture, dense vs sparse_update
+arms compared exactly (test_CompareSparse.cpp's discipline)."""
+
+import pathlib
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.compat.config_parser import parse_config
+from paddle_tpu.core.arg import Arg, id_arg
+from paddle_tpu.network import Network
+from paddle_tpu.optimizers import create_optimizer
+
+REF = "/root/reference/paddle"
+
+pytestmark = pytest.mark.skipif(
+    not pathlib.Path(REF).exists(), reason="reference tree not mounted"
+)
+
+
+@pytest.fixture
+def ref_cwd(monkeypatch):
+    monkeypatch.chdir(REF)
+
+
+def test_chunking_config_trains(ref_cwd):
+    """chunking.conf: raw mixed layer over Full/Table projections into
+    CRF + crf_decoding + sum evaluator. The proto data file the
+    reference generated at build time isn't in the tree, so train on
+    synthetic feeds of the declared slot shapes."""
+    tc = parse_config("trainer/tests/chunking.conf")
+    m = tc.model
+    assert m.output_layer_names == ["crf"]
+    assert [e["type"] for e in tc.evaluators] == ["sum"]
+    # sequence tagging: every slot is per-timestep
+    for n in ("features", "word", "pos", "chunk"):
+        lc = m.layer(n)
+        lc.attrs["is_seq"] = True
+        lc.attrs["is_ids"] = n != "features"
+    net = Network(m)
+    assert net.param_confs["crfw"].dims[0] >= 23
+    params = net.init_params(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    B, T = 4, 6
+    lens = np.asarray([6, 5, 3, 6], np.int32)
+    feed = {
+        "features": Arg(
+            value=(rng.random((B, T, 4339)) < 0.002).astype(np.float32),
+            seq_lens=lens,
+        ),
+        "word": Arg(
+            ids=rng.integers(0, 478, (B, T)).astype(np.int32),
+            seq_lens=lens,
+        ),
+        "pos": Arg(
+            ids=rng.integers(0, 45, (B, T)).astype(np.int32),
+            seq_lens=lens,
+        ),
+        "chunk": Arg(
+            ids=rng.integers(0, 23, (B, T)).astype(np.int32),
+            seq_lens=lens,
+        ),
+    }
+    opt = create_optimizer(tc.opt, net.param_confs)
+    st = opt.init_state(params)
+
+    def loss_fn(p, f):
+        outs, _ = net.forward(p, f)
+        return outs["crf"].value.mean(), ()
+
+    @jax.jit
+    def step(p, s, f):
+        (l, _), g = jax.value_and_grad(loss_fn, has_aux=True)(p, f)
+        p, s = opt.update(g, p, s, 0)
+        return p, s, l
+
+    losses = []
+    for _ in range(25):
+        params, st, l = step(params, st, feed)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.9, (losses[0], losses[-1])
+    assert np.isfinite(losses).all()
+
+
+@pytest.mark.parametrize("conf", ["rnn", "qb_rnn"])
+def test_raw_rnn_configs_build(ref_cwd, conf):
+    """sample_trainer_config_{rnn,qb_rnn}.conf: raw recurrent layer
+    groups (RecurrentLayerGroupBegin/Memory/End) over 8 shared-table
+    slots + rank cost. The 1.45M x 128 shared embedding is too large
+    to initialize in CI — build-level checks only (the reference's own
+    one-pass run of these is exercised at word_dim=999 by the
+    compare_sparse test below)."""
+    tc = parse_config(
+        f"trainer/tests/sample_trainer_config_{conf}.conf",
+        "sparse_update=1",
+    )
+    m = tc.model
+    for lc in m.layers:
+        if lc.type == "data" and lc.name != "label":
+            lc.attrs["is_seq"] = True
+    net = Network(m)
+    assert net.param_confs["embedding.w0"].dims == (1451594, 128)
+    assert net.param_confs["embedding.w0"].sparse_update
+    # the 8 slots share ONE table; rnn1.w0 shared across slots
+    assert net.param_confs["rnn1.w0"].dims == (128, 128)
+    assert "cost" in m.output_layer_names
+    assert tc.opt.learning_rate_schedule == "poly"
+
+
+def _train_compare_sparse(sparse_update: bool, batches, steps=3):
+    tc = parse_config(
+        "trainer/tests/sample_trainer_config_compare_sparse.conf",
+        f"sparse_update={1 if sparse_update else 0}",
+    )
+    m = tc.model
+    for lc in m.layers:
+        if lc.type == "data" and lc.name != "label":
+            lc.attrs["is_seq"] = True
+    net = Network(m)
+    emb = net.param_confs["embedding.w0"]
+    assert emb.dims == (999, 32)
+    if sparse_update:
+        assert emb.sparse_update
+    params = net.init_params(jax.random.key(1))
+    opt = create_optimizer(tc.opt, net.param_confs)
+    st = opt.init_state(params)
+
+    def loss_fn(p, f):
+        outs, _ = net.forward(p, f)
+        return outs["cost"].value.mean(), ()
+
+    @jax.jit
+    def step(p, s, f, i):
+        (l, _), g = jax.value_and_grad(loss_fn, has_aux=True)(p, f)
+        p, s = opt.update(g, p, s, i)
+        return p, s, l
+
+    losses = []
+    i = 0
+    for _ in range(steps):
+        for f in batches:
+            params, st, l = step(params, st, f, i)
+            losses.append(float(l))
+            i += 1
+    return params, losses
+
+
+def _sparse_batches(n_batches=2, batch=20):
+    from paddle_tpu.data.proto_provider import read_proto_data
+
+    hdr, samples = read_proto_data(
+        "trainer/tests/compare_sparse_data"
+    )
+    # declaration order: ltr_network("left") then ("right"), four
+    # slots each (qb, qw, tb, tw) — names concatenate WITHOUT underscore
+    slot_names = [
+        f"{s}{side}" for side in ("left", "right")
+        for s in ("qb", "qw", "tb", "tw")
+    ]
+    batches = []
+    for bi in range(n_batches):
+        chunk = samples[bi * batch : (bi + 1) * batch]
+        feed = {}
+        for si, name in enumerate(slot_names):
+            rows = [
+                [int(x) for x in smp[si]] or [0] for smp in chunk
+            ]
+            tmax = max(len(r) for r in rows)
+            ids = np.zeros((len(rows), tmax), np.int32)
+            lens = np.zeros((len(rows),), np.int32)
+            for ri, r in enumerate(rows):
+                ids[ri, : len(r)] = r
+                lens[ri] = len(r)
+            feed[name] = Arg(ids=ids, seq_lens=lens)
+        feed["label"] = id_arg(
+            np.asarray([int(smp[8]) for smp in chunk], np.int32)
+        )
+        batches.append(feed)
+    return batches
+
+
+def test_compare_sparse_dense_vs_sparse_update(ref_cwd):
+    """test_CompareSparse.cpp: the same config trained with
+    sparse_update on and off must land on the same parameters, on the
+    reference's own 1000-sample proto-sequence fixture."""
+    batches = _sparse_batches()
+    p_dense, l_dense = _train_compare_sparse(False, batches)
+    p_sparse, l_sparse = _train_compare_sparse(True, batches)
+    assert np.isfinite(l_dense).all() and np.isfinite(l_sparse).all()
+    # compare the SAME batch across passes (lr=1e-4 from the config:
+    # tiny but strictly monotone improvement)
+    assert l_dense[-2] < l_dense[0], l_dense
+    assert set(p_dense) == set(p_sparse)
+    for k in p_dense:
+        np.testing.assert_allclose(
+            np.asarray(p_dense[k]), np.asarray(p_sparse[k]),
+            atol=1e-6, err_msg=k,
+        )

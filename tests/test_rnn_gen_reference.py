@@ -97,6 +97,38 @@ def test_beam_matches_reference():
     np.testing.assert_allclose(np.asarray(got), np.asarray(exp), atol=1e-5)
 
 
+@pytest.mark.parametrize("beam", [False, True])
+def test_nest_matches_reference(beam):
+    """sample_trainer_nest_rnn_gen.conf — beam_search nested inside an
+    outer recurrent_group over subsequences (testGen hasSubseq arms,
+    both compared against r1.test.nest). The driver feeds ONE sequence
+    of 15 single-step subsequences; each outer step generates one
+    sequence, so the flat decoder runs with batch=15 and the dump
+    nests all results under sample id 0."""
+    tc = parse_config(
+        f"{REF}/sample_trainer_nest_rnn_gen.conf",
+        {"beam_search": "1"} if beam else {"beam_search": ""},
+    )
+    gen, static_names, attrs = create_config_generator(tc.model, None)
+    assert attrs["num_results"] == 1
+    assert attrs["beam_size"] == (2 if beam else 1)
+    pcs = gen.decoder.param_confs(
+        [Arg(value=np.zeros((1, 2), np.float32))]
+    )
+    gen.params = load_parameter_dir(f"{MODEL}/t1", pcs)
+    results = gen.generate([Arg(value=np.zeros((15, 2), np.float32))])
+    lines = []
+    for i, beams in enumerate(results):
+        assert len(beams) == 1  # num_results_per_sample=1
+        prefix = "0\t" if i == 0 else "\t"
+        lines.append(
+            prefix + " " + " ".join(str(x) for x in beams[0])
+        )
+    got = _floats("\n".join(lines))
+    exp = _floats(open(f"{MODEL}/r1.test.nest").read())
+    assert got == exp, (got[:8], exp[:8])
+
+
 def test_parameter_file_codec():
     w = load_parameter_file(f"{MODEL}/t1/wordvec", (5, 5))
     assert w.shape == (5, 5)
