@@ -716,8 +716,17 @@ def parse_config(config_file, config_args="") -> TrainerConfig:
         conf = g.conf
     finally:
         _stack.pop()
+        # close leaked group scopes FIRST (each __exit__ pops its own
+        # sub-builder; merely dropping the references would run the
+        # suspended context managers' finally at GC time, popping
+        # builders that are no longer top-of-stack)
+        while len(_raw_mod._group_stack) > group_depth:
+            _gname, _cm, *_rest = _raw_mod._group_stack.pop()
+            try:
+                _cm.__exit__(None, None, None)
+            except Exception:
+                pass
         del dsl._stack[dsl_depth:]
-        del _raw_mod._group_stack[group_depth:]
     if ctx.outputs:
         for name in ctx.outputs:
             if name not in conf.output_layer_names:

@@ -21,6 +21,7 @@ _FIXES = [
     "print", "except", "imports", "has_key", "dict", "raise",
     "ne", "numliterals", "funcattrs", "itertools", "itertools_imports",
     "reduce", "basestring", "unicode", "zip", "map", "filter",
+    "next",  # generator.next() -> next(generator)
 ]
 
 
@@ -45,15 +46,17 @@ def to_py3(src: str, name: str = "<py2 script>", force: bool = False) -> str:
         return str(rt.refactor_string(src, name))
 
 
-def load_py2_module(path: str, name: str, extra_globals=None):
+def load_py2_module(path: str, name: str, extra_globals=None,
+                    force: bool = False):
     """Import a python-2-era helper module (e.g. the mnist demo's
     mnist_util.py) with the same mechanical conversion + xrange
     injection, registering it in sys.modules so the driver script's
-    own `import` resolves to it."""
+    own `import` resolves to it. `force` runs the fixers even when the
+    source is syntactically valid py3 (generator.next() etc.)."""
     import types
 
     with open(path) as f:
-        src = to_py3(f.read(), path)
+        src = to_py3(f.read(), path, force=force)
     mod = types.ModuleType(name)
     mod.__file__ = os.path.abspath(path)
     mod.__dict__["xrange"] = range

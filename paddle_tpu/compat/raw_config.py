@@ -127,10 +127,6 @@ def ContextProjection(input_layer_name, context_length, context_start=None,
     )
 
 
-def _as_input(x):
-    if isinstance(x, InputConf):
-        return x
-    return InputConf(name=getattr(x, "name", x))
 
 
 def Layer(name=None, type=None, size=0, active_type="", bias=True,
@@ -142,7 +138,7 @@ def Layer(name=None, type=None, size=0, active_type="", bias=True,
     g = dsl.current()
     if not isinstance(inputs, (list, tuple)):
         inputs = [inputs]
-    ics = [_as_input(x) for x in inputs]
+    ics = [dsl._in(x) for x in inputs]
     bias_param = None
     bias_flag = bool(bias)
     if isinstance(bias, ParameterConf):

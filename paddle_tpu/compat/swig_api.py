@@ -74,9 +74,26 @@ def isGpuVersion() -> bool:
     return False
 
 
+def isUsingGpu() -> bool:
+    """api.isUsingGpu — the use_gpu flag state (host buffers here are
+    always numpy; the device side is XLA's)."""
+    return False
+
+
 def setUseGpu(flag: bool) -> None:
     """api.setUseGpu — accepted for parity; device placement is XLA's
     (the axon TPU backend is used whenever present)."""
+
+
+class RangeError(Exception):
+    """api Matrix/Vector out-of-range access (Paddle.i RangeError)."""
+
+
+# sparse enums (Paddle.i / matrix.h)
+SPARSE_NON_VALUE = 0
+SPARSE_VALUE = 1
+SPARSE_CSR = 0
+SPARSE_CSC = 1
 
 
 def _as2d(a: np.ndarray) -> np.ndarray:
@@ -96,17 +113,82 @@ class Matrix:
         return cls(np.array(a, np.float32, copy=copy))
 
     @classmethod
+    def createCpuDenseFromNumpy(cls, a, copy=True):
+        """copy=False SHARES memory with the numpy matrix
+        (api/Paddle.i:142 zero-copy bridge)."""
+        a = np.asarray(a)
+        if not copy and a.dtype == np.float32 and a.ndim == 2:
+            m = cls.__new__(cls)
+            m._a = a
+            return m
+        return cls(np.array(a, np.float32))
+
+    @classmethod
+    def createGpuDenseFromNumpy(cls, a):
+        return cls(np.array(a, np.float32))
+
+    @classmethod
     def createDense(cls, data, height, width):
-        return cls(np.asarray(data, np.float32).reshape(height, width))
+        a = np.asarray(data, np.float32)
+        if a.size != height * width:
+            # a short LAST batch: the reference's api loaders pass the
+            # nominal batch height with fewer samples' data
+            # (api/test/util.py loadMNISTTrainData's StopIteration
+            # break); the rows that exist win — anything else is a
+            # caller bug and must not be silently reshaped
+            if a.size > height * width or a.size % width:
+                raise ValueError(
+                    f"createDense: {a.size} values do not form "
+                    f"<= {height} rows of width {width}"
+                )
+            height = a.size // width
+        return cls(a.reshape(height, width))
 
     @classmethod
     def createZero(cls, height, width):
         return cls(np.zeros((height, width), np.float32))
 
+    @classmethod
+    def createSparse(cls, height, width, nnz, non_value=True,
+                     trans=False, useGpu=False):
+        """CSR sparse matrix filled by sparseCopyFrom
+        (api Matrix::createSparse + sparseCopyFrom)."""
+        return SparseMatrix(
+            [[] for _ in range(height)], width,
+            with_values=not non_value,
+        )
+
     def copyToNumpyMat(self) -> np.ndarray:
         return np.array(self._a)
 
     toNumpyMat = copyToNumpyMat
+
+    def toNumpyMatInplace(self) -> np.ndarray:
+        """The live buffer — mutations write through (Paddle.i
+        toNumpyMatInplace shared-memory view)."""
+        return self._a
+
+    def copyFromNumpyMat(self, a):
+        np.copyto(self._a, np.asarray(a, np.float32).reshape(self._a.shape))
+
+    def get(self, x, y):
+        """Reference api Matrix::get addressing: flat offset
+        x*width + y, bounds-checked on the flat index."""
+        h, w = self._a.shape
+        flat = x * w + y
+        if x < 0 or y < 0 or flat >= h * w:
+            raise RangeError(f"get({x}, {y}) out of {h}x{w}")
+        return float(self._a[flat // w, flat % w])
+
+    def set(self, x, y, v):
+        h, w = self._a.shape
+        flat = x * w + y
+        if x < 0 or y < 0 or flat >= h * w:
+            raise RangeError(f"set({x}, {y}) out of {h}x{w}")
+        self._a[flat // w, flat % w] = v
+
+    def isGpu(self):
+        return False
 
     def getData(self):
         return self._a.ravel()
@@ -149,6 +231,28 @@ class SparseMatrix(Matrix):
     def isSparse(self):
         return True
 
+    def getSparseValueType(self):
+        return SPARSE_VALUE if self._with_values else SPARSE_NON_VALUE
+
+    def getSparseFormat(self):
+        return SPARSE_CSR
+
+    def sparseCopyFrom(self, rows, cols, values=()):
+        """CSR triples -> row lists (api Matrix::sparseCopyFrom:
+        `rows` are per-row offsets into cols/values)."""
+        new_rows = []
+        for i in range(len(rows) - 1):
+            b, e = int(rows[i]), int(rows[i + 1])
+            if self._with_values:
+                new_rows.append(
+                    [(int(c), float(v))
+                     for c, v in zip(cols[b:e], values[b:e])]
+                )
+            else:
+                new_rows.append([int(c) for c in cols[b:e]])
+        self._rows = new_rows
+        self._dense = None
+
     def getSparseRowCols(self, i):
         if self._with_values:
             return [int(c) for c, _ in self._rows[i]]
@@ -171,11 +275,28 @@ class _VectorBase:
         return cls(np.array(a, cls._dtype, copy=copy))
 
     @classmethod
-    def create(cls, data):
-        return cls(np.asarray(data, cls._dtype))
+    def createCpuVectorFromNumpy(cls, a, copy=True):
+        """copy=False SHARES memory with the numpy array."""
+        a = np.asarray(a)
+        if not copy and a.dtype == cls._dtype and a.ndim == 1:
+            v = cls.__new__(cls)
+            v._a = a
+            return v
+        return cls(np.array(a, cls._dtype))
 
     @classmethod
-    def createZero(cls, n):
+    def createGpuVectorFromNumpy(cls, a):
+        return cls(np.array(a, cls._dtype))
+
+    @classmethod
+    def create(cls, data, useGpu=False):
+        try:
+            return cls(np.asarray(data, cls._dtype))
+        except TypeError:  # generator input
+            return cls(np.asarray(list(data), cls._dtype))
+
+    @classmethod
+    def createZero(cls, n, useGpu=False):
         return cls(np.zeros(n, cls._dtype))
 
     def copyToNumpyArray(self) -> np.ndarray:
@@ -183,12 +304,38 @@ class _VectorBase:
 
     toNumpyArray = copyToNumpyArray
 
+    def toNumpyArrayInplace(self) -> np.ndarray:
+        return self._a
+
+    def getData(self) -> list:
+        return self._a.tolist()
+
+    def isGpu(self):
+        return False
+
+    def __getitem__(self, i):
+        if i < 0 or i >= self._a.size:
+            raise RangeError(f"index {i} out of {self._a.size}")
+        v = self._a[i]
+        return int(v) if self._dtype == np.int32 else float(v)
+
+    def __setitem__(self, i, v):
+        if i < 0 or i >= self._a.size:
+            raise RangeError(f"index {i} out of {self._a.size}")
+        self._a[i] = v
+
+    def __iter__(self):
+        return iter(self.getData())
+
     def __len__(self):
         return int(self._a.size)
 
     def copyFrom(self, other):
         self._a = np.array(other._a if isinstance(other, _VectorBase)
                            else other, self._dtype).ravel()
+
+    def copyFromNumpyArray(self, a):
+        self.copyFrom(np.asarray(a))
 
 
 class Vector(_VectorBase):
@@ -245,10 +392,10 @@ class Arguments:
     def setSlotFrameWidth(self, i, w: int):
         self._slot(i)["frame_w"] = int(w)
 
-    def getSlotFrameHeight(self, i) -> int:
+    def getSlotFrameHeight(self, i=0) -> int:
         return self._slots[i].get("frame_h", 0)
 
-    def getSlotFrameWidth(self, i) -> int:
+    def getSlotFrameWidth(self, i=0) -> int:
         return self._slots[i].get("frame_w", 0)
 
     def _setSlotArg(self, i, arg: Arg):
@@ -329,10 +476,14 @@ def _flatten_arg_ids(a: Arg) -> np.ndarray:
     return np.concatenate([ids[i, : lens[i]] for i in range(len(lens))])
 
 
-class ParameterBuffer:
+class ParameterBuffer(Vector):
     """A live view of one parameter buffer (api Vector over
     Parameter::getBuf). copyFrom writes THROUGH to the owning machine —
-    the GAN driver's copy_shared_parameters depends on that."""
+    the GAN driver's copy_shared_parameters depends on that.
+    toNumpyArrayInplace returns a registered host mirror whose
+    mutations the machine syncs back before the next program run (the
+    testTrain init_params idiom: mutate the inplace view, then
+    forward)."""
 
     def __init__(self, gm: "GradientMachine", name: str, kind: int):
         self._gm = gm
@@ -344,7 +495,14 @@ class ParameterBuffer:
             g = self._gm._grads.get(self._name)
             return np.zeros(self._len(), np.float32) if g is None \
                 else np.asarray(g).ravel()
+        view = self._gm._inplace_views.get(self._name)
+        if view is not None:
+            return view
         return np.asarray(self._gm.params[self._name]).ravel()
+
+    @property
+    def _a(self) -> np.ndarray:  # the Vector surface reads live
+        return self._read()
 
     def _len(self):
         return int(np.prod(self._gm.net.param_confs[self._name].dims))
@@ -354,6 +512,24 @@ class ParameterBuffer:
 
     def copyToNumpyArray(self):
         return np.array(self._read(), np.float32)
+
+    def toNumpyArrayInplace(self) -> np.ndarray:
+        if self._kind != PARAMETER_VALUE:
+            return self._read()
+        views = self._gm._inplace_views
+        if self._name not in views:
+            views[self._name] = np.array(
+                np.asarray(self._gm.params[self._name]).ravel(),
+                np.float32,
+            )
+        return views[self._name]
+
+    def __setitem__(self, i, v):
+        if self._kind != PARAMETER_VALUE:
+            raise ValueError("only PARAMETER_VALUE buffers are writable")
+        if i < 0 or i >= self._len():
+            raise RangeError(f"index {i} out of {self._len()}")
+        self.toNumpyArrayInplace()[i] = v
 
     def copyFrom(self, other):
         src = other._read() if isinstance(other, ParameterBuffer) else (
@@ -365,9 +541,39 @@ class ParameterBuffer:
         self._gm.params[self._name] = jax.numpy.asarray(
             np.asarray(src, np.float32).reshape(shape)
         )
+        self._gm._refresh_views(self._name)
 
     def copyFromNumpyArray(self, a):
         self.copyFrom(np.asarray(a, np.float32))
+
+
+class _ParamConfView:
+    """What Parameter.getConfig() returns: the ParameterConf plus the
+    proto-shim bridge (api Parameter::getConfig ->
+    ParameterConfig.toProto; dims follow the reference's (1, n)
+    convention for vector parameters)."""
+
+    def __init__(self, pc):
+        self._pc = pc
+
+    def __getattr__(self, name):
+        return getattr(self._pc, name)
+
+    def toProto(self):
+        from paddle.proto.ParameterConfig_pb2 import ParameterConfig
+
+        dims = tuple(int(d) for d in self._pc.dims)
+        if len(dims) == 1:
+            dims = (1, dims[0])
+        size = 1
+        for d in dims:
+            size *= d
+        return ParameterConfig(
+            name=self._pc.name, size=size, dims=list(dims),
+            learning_rate=self._pc.learning_rate,
+            is_static=self._pc.is_static,
+            sparse_update=self._pc.sparse_update,
+        )
 
 
 class Parameter:
@@ -378,11 +584,46 @@ class Parameter:
     def getName(self):
         return self._name
 
+    def getID(self):
+        """Position in the machine's parameter order (api
+        Parameter::getID)."""
+        return self._gm._param_names.index(self._name)
+
     def getSize(self):
         return int(np.prod(self._gm.net.param_confs[self._name].dims))
 
     def getBuf(self, kind):
         return ParameterBuffer(self._gm, self._name, kind)
+
+    def getBufs(self):
+        """(value, gradient) buffers — what the api update callback
+        hands the optimizer (Parameter::getBufs)."""
+        return (
+            ParameterBuffer(self._gm, self._name, PARAMETER_VALUE),
+            ParameterBuffer(self._gm, self._name, PARAMETER_GRADIENT),
+        )
+
+    def save(self, filename) -> bool:
+        """Write the reference raw binary format
+        (Parameter::save)."""
+        from paddle_tpu.trainer.checkpoint import save_parameter_file
+
+        self._gm._sync_views()
+        save_parameter_file(
+            filename, np.asarray(self._gm.params[self._name])
+        )
+        return True
+
+    def load(self, filename) -> bool:
+        """Read the reference raw binary format (Parameter::load)."""
+        from paddle_tpu.trainer.checkpoint import load_parameter_file
+
+        shape = self._gm.params[self._name].shape
+        self._gm.params[self._name] = jax.numpy.asarray(
+            load_parameter_file(filename, shape)
+        )
+        self._gm._refresh_views(self._name)
+        return True
 
     def setValueUpdated(self):
         pass  # device copy already happened in ParameterBuffer.copyFrom
@@ -391,7 +632,7 @@ class Parameter:
         return self.getSize()
 
     def getConfig(self):
-        return self._gm.net.param_confs[self._name]
+        return _ParamConfView(self._gm.net.param_confs[self._name])
 
 
 class Evaluator:
@@ -453,6 +694,8 @@ class GradientMachine:
         self.params = self.net.init_params(init_key)
         self.state = self.net.init_state()
         self._grads: dict = {}
+        self._last_rng = None  # rng of the latest forward (backward reuses)
+        self._inplace_views: dict = {}  # name -> mutable host mirror
         self._param_names = sorted(self.net.param_confs)
         self._fwd_cache: dict = {}
         self._last = None  # (outs, feed) of the latest forward
@@ -472,6 +715,26 @@ class GradientMachine:
             c["input"] for c in self._eval_confs
         }
 
+    def _sync_views(self):
+        """Flush registered toNumpyArrayInplace mirrors into params
+        (mutate-then-run semantics of the inplace api)."""
+        for name, v in self._inplace_views.items():
+            shape = self.params[name].shape
+            self.params[name] = jax.numpy.asarray(
+                np.asarray(v, np.float32).reshape(shape)
+            )
+
+    def _refresh_views(self, name=None):
+        """After params change OUTSIDE the mirrors (training step,
+        load, copyFrom), copy the fresh values INTO any registered
+        mirrors so user-held inplace arrays stay live (the reference's
+        inplace view IS the parameter memory)."""
+        names = [name] if name is not None else list(self._inplace_views)
+        for n in names:
+            v = self._inplace_views.get(n)
+            if v is not None:
+                np.copyto(v, np.asarray(self.params[n]).ravel())
+
     def makeEvaluator(self) -> Evaluator:
         return Evaluator(self._eval_confs)
 
@@ -483,6 +746,10 @@ class GradientMachine:
     def createFromConfigProto(cls, conf, mode=CREATE_MODE_NORMAL,
                               enable_types=None):
         return cls(conf)
+
+    # api GradientMachine::createByModelConfig — same constructor, the
+    # mode/parameter-type hints are the reference's buffer plumbing
+    createByModelConfig = None  # bound after class body
 
     # --- parameters ---
     def getParameterSize(self):
@@ -552,9 +819,13 @@ class GradientMachine:
 
     def _next_rng(self):
         self._rng_step += 1
-        return _rng.split_for_step(self._rng_key, self._rng_step)
+        self._last_rng = _rng.split_for_step(
+            self._rng_key, self._rng_step
+        )
+        return self._last_rng
 
     def forward(self, inArgs: Arguments, outArgs: Arguments, passType=None):
+        self._sync_views()
         train = passType == PASS_TRAIN
         feed = inArgs._feed(self.net.input_names)
         outs, new_state = self._fwd(train)(
@@ -575,6 +846,7 @@ class GradientMachine:
             outArgs._slot(i)["arg"] = a
 
     def forwardTest(self, inArgs: Arguments):
+        self._sync_views()
         """Reference api: returns [{'id': ids, 'value': values}] per
         output layer (py_paddle util swig_paddle.py forwardTest)."""
         feed = inArgs._feed(self.net.input_names)
@@ -595,8 +867,38 @@ class GradientMachine:
             res.append(d)
         return res
 
+    def backward(self, callback=None):
+        """Gradient pass over the LAST forward's batch, then the
+        per-parameter UpdateCallback (GradientMachine.h:72 backward;
+        the api test drives forward + backward separately)."""
+        assert self._last is not None, "backward() before forward()"
+        self._sync_views()
+        _, feed = self._last
+        if "grad_only" not in self._fwd_cache:
+
+            def go(params, state, feed, rng):
+                (loss, (outs, new_state)), grads = jax.value_and_grad(
+                    self.net.loss_fn, has_aux=True
+                )(params, feed, state=state, train=True, rng=rng)
+                return loss, grads
+
+            self._fwd_cache["grad_only"] = jax.jit(go)
+        _, grads = self._fwd_cache["grad_only"](
+            self.params, self.state, feed,
+            # the rng the preceding forward used — gradients must
+            # belong to the activations the caller saw (same dropout
+            # masks), as the reference backprops stored activations
+            self._last_rng if self._last_rng is not None
+            else self._next_rng(),
+        )
+        self._grads = grads
+        if callback is not None:
+            for n in self._param_names:
+                callback(Parameter(self, n))
+
     def forwardBackward(self, inArgs: Arguments, outArgs: Arguments,
-                        passType=None):
+                        passType=None, callback=None):
+        self._sync_views()
         feed = inArgs._feed(self.net.input_names)
         if "grad" not in self._fwd_cache:
             keep = self._keep
@@ -619,6 +921,12 @@ class GradientMachine:
         outArgs.resize(len(self.net.output_names))
         for i, n in enumerate(self.net.output_names):
             outArgs.setSlotValue(i, Matrix(_flatten_arg_value(outs[n])))
+        if callback is not None:
+            # the per-parameter UpdateCallback (GradientMachine.h:72
+            # backward(callback)): invoked once per parameter after
+            # its gradient exists
+            for n in self._param_names:
+                callback(Parameter(self, n))
         return float(loss)
 
     def start(self):
@@ -753,15 +1061,23 @@ class Trainer:
             self.global_step, rng,
         )
         self.global_step += 1
+        self.gm._refresh_views()  # keep user-held inplace arrays live
         self._batch += 1
         self._last_cost = float(loss)
+        self._last_outs = [
+            {"value": np.asarray([self._last_cost * size])}
+        ]
         if self._batch % _flags.get_flag("log_period") == 0:
             log.info("pass %d batch %d cost %.5f",
                      self._pass, self._batch, self._last_cost)
         return self._last_cost
 
     def getForwardOutput(self):
-        return []
+        """Latest forward outputs as [{'value': ndarray}] — the
+        reference returns the out-args' value matrices; the train/test
+        batch paths record the cost output (api Trainer::
+        getForwardOutput)."""
+        return getattr(self, "_last_outs", [])
 
     # --- test period (api Trainer::startTestPeriod) ---
     def startTestPeriod(self):
@@ -770,9 +1086,101 @@ class Trainer:
     def testOneDataBatch(self, size: int, args: Arguments):
         out = Arguments.createArguments(0)
         self.gm.forward(args, out, PASS_TEST)
+        self._last_outs = [
+            {"value": out.getSlotValue(i).copyToNumpyMat().ravel()}
+            for i in range(out.getSlotNum())
+        ]
         self._test_costs.append(out.sum() / max(size, 1))
         return self._test_costs[-1]
 
     def finishTestPeriod(self):
         if self._test_costs:
             log.info("test cost %.5f", float(np.mean(self._test_costs)))
+
+
+# ---- raw-api config / optimizer surface (testGradientMachine.py,
+#      testTrain.py, testTrainer.py) ---------------------------------
+
+
+class TrainerConfig:
+    """api TrainerConfig (api/Trainer.cpp createFromTrainerConfigFile):
+    parse a config file, expose the model/optimization halves."""
+
+    def __init__(self, tc):
+        self._tc = tc
+
+    @classmethod
+    def createFromTrainerConfigFile(cls, path):
+        from paddle_tpu.compat.config_parser import parse_config
+
+        return cls(parse_config(path))
+
+    def getModelConfig(self):
+        return self._tc.model_config
+
+    def getOptimizationConfig(self):
+        return self._tc.opt_config
+
+    def __getattr__(self, name):
+        return getattr(self._tc, name)
+
+
+class OptimizationConfig:
+    """api OptimizationConfig — a pass-through over the framework's
+    OptimizationConf (createFromProto accepts it directly)."""
+
+    @staticmethod
+    def createFromProto(opt_conf):
+        return opt_conf
+
+
+class ParameterOptimizer:
+    """The api-level LOCAL optimizer the raw training loop drives per
+    parameter (api/ParameterOptimizer.cpp: create/init/startPass/
+    startBatch/update(bufs, config)/finishBatch/finishPass). Applies
+    the config's learning rate as a plain first-order step on the
+    (value, gradient) buffers — the in-place equivalent of the
+    reference's per-parameter optimizer chain."""
+
+    def __init__(self, opt_conf):
+        self.conf = opt_conf
+
+    @classmethod
+    def create(cls, opt_conf):
+        return cls(opt_conf)
+
+    def getParameterTypes(self):
+        return [PARAMETER_VALUE, PARAMETER_GRADIENT]
+
+    def init(self, num_rows, param_config):
+        pass
+
+    def startPass(self):
+        pass
+
+    def finishPass(self):
+        pass
+
+    def startBatch(self, batch_size):
+        self._batch_size = batch_size
+
+    def finishBatch(self):
+        pass
+
+    def update(self, vecs, param_config, sparse_id=NO_SPARSE_ID):
+        value, grad = vecs[0], vecs[1]
+        lr = float(getattr(self.conf, "learning_rate", 0.01)) * float(
+            getattr(param_config, "learning_rate", 1.0)
+        )
+        value.copyFrom(
+            value.copyToNumpyArray() - lr * grad.copyToNumpyArray()
+        )
+
+    def needSpecialTraversal(self, param_config):
+        return None
+
+
+GradientMachine.createByModelConfig = classmethod(
+    lambda cls, conf, mode=CREATE_MODE_NORMAL, enable_types=None:
+    cls(conf)
+)

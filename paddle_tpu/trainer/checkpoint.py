@@ -125,6 +125,18 @@ def load_parameter_file(path: str, dims=None) -> "np.ndarray":
     return arr.reshape(dims) if dims is not None else arr
 
 
+def save_parameter_file(path: str, arr) -> None:
+    """Write one parameter in the reference's raw binary format
+    (Parameter::save: the same 16-byte header + float32 payload
+    load_parameter_file reads)."""
+    import struct
+
+    arr = np.asarray(arr, np.float32)
+    with open(path, "wb") as f:
+        f.write(struct.pack(_TAR_HEADER, 0, 4, arr.size))
+        f.write(arr.tobytes())
+
+
 def load_parameter_dir(model_dir: str, param_confs: dict) -> dict:
     """A reference model directory (trainer/ParamUtil.h loadParameters:
     one raw binary file per parameter, named by parameter) -> params
