@@ -49,6 +49,15 @@ class Evaluator:
         p = np.asarray(pred.value)
         l = np.asarray(label.ids if label.ids is not None else label.value)
         if pred.is_seq:
+            if l.ndim >= 2 and l.shape[1] != p.shape[1]:
+                # independent padding (a per-subsequence prediction vs
+                # the label's own bucket) — align to the prediction's
+                # time axis; padding is masked below either way
+                tp = p.shape[1]
+                if l.shape[1] > tp:
+                    l = l[:, :tp]
+                else:
+                    l = np.pad(l, ((0, 0), (0, tp - l.shape[1])))
             m = np.asarray(pred.mask())
             p = p.reshape(-1, p.shape[-1])
             l = l.reshape(-1)

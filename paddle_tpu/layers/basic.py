@@ -49,21 +49,24 @@ class FCLayer(Layer):
         out = self.conf.size
         pcs = {}
         seq = any(s.is_seq for s in in_specs)
+        sub = any(s.has_subseq for s in in_specs)
         for i, s in enumerate(in_specs):
             pcs[f"w{i}"] = self.weight_conf(i, (s.size, out))
         b = self.bias_conf((out,))
         if b is not None:
             pcs["b"] = b
-        return Spec(dim=(out,), is_seq=seq), pcs
+        return Spec(dim=(out,), is_seq=seq, has_subseq=sub), pcs
 
     def forward(self, params, inputs, ctx):
         y = None
         seq_lens = None
+        subseq_lens = None
         any_seq = any(a.is_seq for a in inputs)
         for i, arg in enumerate(inputs):
             x = arg.value
             if arg.is_seq:
                 seq_lens = arg.seq_lens
+                subseq_lens = arg.subseq_lens
             x = x.reshape(x.shape[: 2 if arg.is_seq else 1] + (-1,))
             t = jnp.dot(x, params[f"w{i}"])
             if any_seq and not arg.is_seq:
@@ -76,7 +79,7 @@ class FCLayer(Layer):
         if "b" in params:
             y = y + params["b"]
         y = self.apply_activation_and_dropout(y, ctx, seq_lens)
-        return Arg(value=y, seq_lens=seq_lens)
+        return Arg(value=y, seq_lens=seq_lens, subseq_lens=subseq_lens)
 
 
 @LAYERS.register("embedding")
@@ -95,7 +98,14 @@ class EmbeddingLayer(Layer):
         pc.sparse_update = True
         if self.conf.attrs.get("sharded", False):
             pc.sparse_remote_update = True  # row-shard over the mesh
-        return Spec(dim=(self.conf.size,), is_seq=s.is_seq), {"w0": pc}
+        return (
+            Spec(
+                dim=(self.conf.size,),
+                is_seq=s.is_seq,
+                has_subseq=s.has_subseq,  # nested slots stay nested
+            ),
+            {"w0": pc},
+        )
 
     def forward(self, params, inputs, ctx):
         (arg,) = inputs
@@ -104,7 +114,9 @@ class EmbeddingLayer(Layer):
             from paddle_tpu.ops.sequence_ops import _mask
 
             y = y * _mask(arg.seq_lens, y.shape[1], y.dtype)[..., None]
-        return Arg(value=y, seq_lens=arg.seq_lens)
+        return Arg(
+            value=y, seq_lens=arg.seq_lens, subseq_lens=arg.subseq_lens
+        )
 
 
 @LAYERS.register("addto")
@@ -138,6 +150,7 @@ class ConcatLayer(Layer):
 
     def build(self, in_specs):
         seq = any(s.is_seq for s in in_specs)
+        self._sub = any(s.has_subseq for s in in_specs)
         self._image = (
             all(len(s.dim) == 3 for s in in_specs)
             and len({s.dim[:2] for s in in_specs}) == 1
@@ -150,21 +163,24 @@ class ConcatLayer(Layer):
             b = self.bias_conf((h * w * c,))
             if b is not None:
                 pcs["b"] = b
-            return Spec(dim=(h, w, c), is_seq=seq), pcs
+            return Spec(dim=(h, w, c), is_seq=seq,
+                        has_subseq=self._sub), pcs
         tot = sum(s.size for s in in_specs)
         b = self.bias_conf((tot,))
         if b is not None:
             pcs["b"] = b
-        return Spec(dim=(tot,), is_seq=seq), pcs
+        return Spec(dim=(tot,), is_seq=seq, has_subseq=self._sub), pcs
 
     def forward(self, params, inputs, ctx):
         flat = []
         seq_lens = None
+        subseq_lens = None
         for i, a in enumerate(inputs):
             x = a.value
             lead = 2 if a.is_seq else 1
             if a.is_seq:
                 seq_lens = a.seq_lens
+                subseq_lens = a.subseq_lens
             if self._image:
                 x = x.reshape(x.shape[:lead] + self._in_dims[i])
             else:
@@ -175,7 +191,7 @@ class ConcatLayer(Layer):
             b = params["b"]
             y = y + (b.reshape(y.shape[-3:]) if self._image else b)
         y = self.apply_activation_and_dropout(y, ctx, seq_lens)
-        return Arg(value=y, seq_lens=seq_lens)
+        return Arg(value=y, seq_lens=seq_lens, subseq_lens=subseq_lens)
 
 
 @LAYERS.register("cos")
@@ -349,20 +365,24 @@ class MixedLayer(Layer):
         b = self.bias_conf((bias_width,))
         if b is not None:
             pcs["b"] = b
+        sub = any(s.has_subseq for s in in_specs)
         if self._shared_bias and len(in_specs[0].dim) == 3:
             # a mixed over conv projections keeps the conv's spatial
             # shape (reference ConvProjection output) so a downstream
             # concat merges CHANNELS, matching a concat of conv layers
-            return Spec(dim=in_specs[0].dim, is_seq=seq), pcs
-        return Spec(dim=(out,), is_seq=seq), pcs
+            return Spec(dim=in_specs[0].dim, is_seq=seq,
+                        has_subseq=sub), pcs
+        return Spec(dim=(out,), is_seq=seq, has_subseq=sub), pcs
 
     def forward(self, params, inputs, ctx):
         y = None
         seq_lens = None
+        subseq_lens = None
         for i, (a, ic) in enumerate(zip(inputs, self.conf.inputs)):
             proj = ic.attrs.get("proj", "full_matrix")
             if a.is_seq:
                 seq_lens = a.seq_lens
+                subseq_lens = a.subseq_lens
             if proj == "identity":
                 t = a.value
             elif proj == "full_matrix":
@@ -408,7 +428,7 @@ class MixedLayer(Layer):
                 b = jnp.tile(b, y.shape[-1] // b.shape[0])
             y = y + b
         y = self.apply_activation_and_dropout(y, ctx, seq_lens)
-        return Arg(value=y, seq_lens=seq_lens)
+        return Arg(value=y, seq_lens=seq_lens, subseq_lens=subseq_lens)
 
 
 @LAYERS.register("tensor")

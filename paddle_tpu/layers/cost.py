@@ -49,6 +49,33 @@ class CostLayerBase(Layer):
         w = rest[0].value.reshape(cost_arg.value.shape[0])
         return Arg(value=cost_arg.value * w)
 
+    @staticmethod
+    def _aligned_ids(pred: Arg, label: Arg):
+        """(ids, label_mask): label ids padded/trimmed to the
+        prediction's time axis, plus the LABEL's own validity mask on
+        that axis (None when no reconciliation applies). The reference
+        carries exact flat lengths; here independent padding can
+        differ — e.g. a per-subsequence prediction sequence (S_max
+        from the nested slot) vs a label sequence padded to its own
+        bucket (sequence_nest_layer_group.conf). Multiplying the cost
+        by the label mask keeps a REAL length mismatch conservative:
+        positions with no real label contribute zero cost instead of
+        phantom class-0 terms."""
+        ids = label.ids
+        lmask = None
+        if pred.seq_lens is not None and ids is not None and ids.ndim == 2:
+            tp = pred.value.shape[1]
+            tl = ids.shape[1]
+            if tl > tp:
+                ids = ids[:, :tp]
+            elif tl < tp:
+                ids = jnp.pad(ids, ((0, 0), (0, tp - tl)))
+            if label.seq_lens is not None:
+                lmask = (
+                    jnp.arange(tp)[None, :] < label.seq_lens[:, None]
+                ).astype(pred.value.dtype)
+        return ids, lmask
+
 
 @LAYERS.register("multi-class-cross-entropy", "cross_entropy")
 class MultiClassCrossEntropy(CostLayerBase):
@@ -57,12 +84,14 @@ class MultiClassCrossEntropy(CostLayerBase):
 
     def forward(self, params, inputs, ctx):
         prob, label, *rest = inputs
+        ids, lmask = self._aligned_ids(prob, label)
         p = jnp.take_along_axis(
-            prob.value, label.ids[..., None], axis=-1
+            prob.value, ids[..., None], axis=-1
         )[..., 0]
-        return self._weighted(
-            self._reduce(-jnp.log(jnp.maximum(p, _EPS)), prob), rest
-        )
+        per = -jnp.log(jnp.maximum(p, _EPS))
+        if lmask is not None:
+            per = per * lmask
+        return self._weighted(self._reduce(per, prob), rest)
 
 
 @LAYERS.register("classification_cost", "softmax_with_cross_entropy")
@@ -73,11 +102,15 @@ class SoftmaxCrossEntropy(CostLayerBase):
 
     def forward(self, params, inputs, ctx):
         logits, label, *rest = inputs
+        ids, lmask = self._aligned_ids(logits, label)
         lse = jax.scipy.special.logsumexp(logits.value, axis=-1)
         picked = jnp.take_along_axis(
-            logits.value, label.ids[..., None], axis=-1
+            logits.value, ids[..., None], axis=-1
         )[..., 0]
-        return self._weighted(self._reduce(lse - picked, logits), rest)
+        per = lse - picked
+        if lmask is not None:
+            per = per * lmask
+        return self._weighted(self._reduce(per, logits), rest)
 
 
 @LAYERS.register("square_error", "sum_of_squares", "mse")
@@ -214,14 +247,17 @@ class MultiClassCrossEntropyWithSelfNorm(CostLayerBase):
 
     def forward(self, params, inputs, ctx):
         prob, label = inputs
+        ids, lmask = self._aligned_ids(prob, label)
         z = jnp.sum(prob.value, axis=-1)
         p = jnp.take_along_axis(
             prob.value / jnp.maximum(z, _EPS)[..., None],
-            label.ids[..., None],
+            ids[..., None],
             axis=-1,
         )[..., 0]
         alpha = self.conf.attrs.get("softmax_selfnorm_alpha", 0.1)
         per = -jnp.log(jnp.maximum(p, _EPS)) + alpha * jnp.square(
             jnp.log(jnp.maximum(z, _EPS))
         )
+        if lmask is not None:
+            per = per * lmask
         return self._reduce(per, prob)
