@@ -422,6 +422,63 @@ def bench_lstm(bs, hidden):
     }
 
 
+def bench_longctx(bs=4, t=4096, d=512, heads=8, layers=2, classes=512):
+    """Long-context causal self-attention training throughput — the
+    capability the 2017 reference lacks entirely (SURVEY §5 'no ring
+    attention / CP'; its sequence story is padding-free batching).
+    Single-chip arm of the long-sequence design whose multi-chip
+    ring/Ulysses shardings the driver gate witnesses
+    (__graft_entry__.dryrun_multichip): embedding -> N causal MHA
+    blocks with residual fc -> per-token classification. Tokens/s
+    counts B*T per optimizer step."""
+    from paddle_tpu import dsl
+    from paddle_tpu.core.arg import id_arg
+    from paddle_tpu.core.config import OptimizationConf
+
+    with dsl.model() as m:
+        ids = dsl.data("ids", dim=(), is_ids=True, is_seq=True)
+        lbl = dsl.data("label", dim=(), is_ids=True, is_seq=True)
+        x = dsl.embedding(ids, size=d, vocab_size=32000)
+        for _ in range(layers):
+            att = dsl._add(
+                "multi_head_attention", [x], size=d,
+                num_heads=heads, causal=True, seq_parallel="none",
+            )
+            x = dsl.addto(att, dsl.fc(att, size=d, act="relu"))
+        out = dsl.fc(x, size=classes, act="")
+        dsl.classification_cost(out, lbl)
+    conf = m.conf
+    rng = np.random.default_rng(0)
+    lens = np.full((bs,), t, np.int32)
+    feed = {
+        "ids": id_arg(
+            rng.integers(0, 32000, (bs, t)).astype(np.int32), lens
+        ),
+        "label": id_arg(
+            rng.integers(0, classes, (bs, t)).astype(np.int32), lens
+        ),
+    }
+    opt = OptimizationConf(learning_method="adam", learning_rate=1e-3)
+    ms = _time_train(conf, feed, opt, iters=10, warmup=10)
+    toks = bs * t / (ms / 1e3)
+    # model FLOPs/step (fwd+bwd=3x fwd): per layer QKVO projections
+    # 4 matmuls * 2*B*T*D^2 + attention 4*B*T^2*D (QK^T and attn@V,
+    # 2*B*T^2*D each; causal halves the useful work but the dense
+    # kernel computes the full square) + mlp 2*B*T*D^2, plus the
+    # output head 2*B*T*D*classes
+    fwd = layers * (
+        4 * 2 * bs * t * d * d + 2 * 2 * bs * t * t * d
+        + 2 * bs * t * d * d
+    ) + 2 * bs * t * d * classes
+    mfu = 3 * fwd * (1e3 / ms) / TPU_PEAK_FLOPS
+    return {
+        "value": round(toks, 1),
+        "unit": "tokens/s/chip (causal self-attention, T=%d)" % t,
+        "ms_per_step": round(ms, 2),
+        "analytic_mfu": round(mfu, 3),
+    }
+
+
 def bench_lstm_fused_vs_scan(bs=128, hidden=256):
     """Fused Pallas LSTM (fwd + reverse-time bwd kernels) vs the
     lax.scan lowering, same TRAINING step. value = scan_ms / fused_ms
@@ -871,6 +928,9 @@ def build_sweep():
         ("ctr_widedeep_sparse_v_independence",
          bench_ctr_widedeep_sparse),
         ("lstm_train_fused_speedup_vs_scan", bench_lstm_fused_vs_scan),
+        ("longctx_selfattn_train_tokens_per_s_t4096", bench_longctx),
+        ("longctx_selfattn_train_tokens_per_s_t8192",
+         lambda: bench_longctx(bs=1, t=8192)),
     ]
     for bs in (64, 128, 256, 512):
         sweep.append(
@@ -918,6 +978,12 @@ def _annotate_baseline(line, name):
     elif name.startswith("ctr_sparse") or name.startswith("ctr_widedeep"):
         line["vs_baseline"] = round(4.0 / max(line["value"], 1e-9), 2)
         line["baseline"] = "O(V) dense update would be ~4.0"
+    elif name.startswith("longctx_"):
+        line["vs_baseline"] = 1.0
+        line["baseline"] = (
+            "no reference capability (2017: no long-context "
+            "attention; SURVEY §5)"
+        )
 
 
 def main(argv):

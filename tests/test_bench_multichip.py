@@ -73,3 +73,16 @@ def test_multichip_rows_cover_reference_matrix():
     for (model, total) in mc.MC_BASELINES_MS:
         assert any(r[1] == model and r[2] == total for r in rows), (
             model, total)
+
+
+def test_longctx_row_smoke():
+    """The long-context bench rows (bench.bench_longctx) build and
+    measure at tiny shapes on the CPU mesh — the correctness smoke for
+    the single-chip long-sequence arm (the multi-chip ring/Ulysses
+    shardings are witnessed by the driver gate)."""
+    import bench
+
+    r = bench.bench_longctx(bs=2, t=64, d=32, heads=4, layers=1,
+                            classes=16)
+    assert r["value"] > 0 and r["ms_per_step"] > 0
+    assert 0 <= r["analytic_mfu"] < 1
