@@ -67,9 +67,17 @@ class MultiHeadAttentionLayer(Layer):
         from paddle_tpu.parallel import ring
 
         if mode == "none":
-            out = ring.dense_attention(
-                q, k, v, causal=causal, kv_len=kva.seq_lens
-            )
+            # attn_impl "flash" uses the Pallas TPU kernel (no
+            # materialized [B,H,T,T] scores) — the long-context lever;
+            # "dense" stays the default (runs on every backend)
+            if self.conf.attrs.get("attn_impl", "dense") == "flash":
+                out = ring.flash_dense_attention(
+                    q, k, v, causal=causal, kv_len=kva.seq_lens
+                )
+            else:
+                out = ring.dense_attention(
+                    q, k, v, causal=causal, kv_len=kva.seq_lens
+                )
         else:
             from paddle_tpu.core.mesh import get_mesh
 

@@ -49,6 +49,41 @@ def dense_attention(q, k, v, *, causal=False, kv_len=None, scale=None):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def flash_dense_attention(q, k, v, *, causal=False, kv_len=None,
+                          scale=None):
+    """Single-chip flash attention (jax.experimental.pallas TPU
+    kernel): same contract as dense_attention — q,k,v [B, T, H, D],
+    kv_len [B] — but never materializes the [B, H, T, T] score matrix
+    in HBM (the bandwidth bound of the dense path at long T). Padding
+    is masked via segment ids (pad tokens get segment 0, valid get 1,
+    and cross-segment attention is masked by the kernel); padded QUERY
+    rows still emit garbage, which the attention layer zeroes after
+    the output projection exactly as in the dense path."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        SegmentIds,
+        flash_attention as _flash,
+    )
+
+    B, T, H, D = q.shape
+    scale = (
+        float(scale)
+        if scale is not None
+        else 1.0 / float(jnp.sqrt(jnp.float32(D)))
+    )
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    seg = None
+    if kv_len is not None:
+        ids = (
+            jnp.arange(T)[None, :] < kv_len[:, None]
+        ).astype(jnp.int32)
+        seg = SegmentIds(q=ids, kv=ids)
+    o = _flash(qt, kt, vt, segment_ids=seg, causal=causal,
+               sm_scale=scale)
+    return o.transpose(0, 2, 1, 3)
+
+
 def _ring_body(axis_name, n_shards, causal, scale, q, k0, v0, q_off, kv_lens):
     """Online-softmax accumulation over ring steps. Shapes per shard:
     q: [B, Tq, H, D]; k0/v0: [B, Tk, H, D] (local shard); q_off scalar
