@@ -39,6 +39,52 @@ import time
 
 import numpy as np
 
+# --- full-row record (ROADMAP item 5b) -------------------------------
+#
+# The summary trailer keeps only north-star headlines, so rows that
+# scroll out of a bounded tail capture (fused-LSTM A/B, longctx, the
+# multichip matrix) used to exist in NO committed artifact. Every row
+# emitted by bench.py / bench_multichip.py is therefore also appended
+# to BENCH_full_rNN.jsonl next to this file (NN = newest committed
+# BENCH_rNN.json + 1), which the end-of-round snapshot commits.
+# Override with BENCH_FULL_RECORD=<path>; set it empty to disable
+# (tests spawning bench subprocesses point it at a tmp file).
+
+_FULL_RECORD = ["unset"]
+
+
+def _full_record_path():
+    p = os.environ.get("BENCH_FULL_RECORD")
+    if p is not None:
+        return p or None  # "" disables
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = [
+        int(m.group(1))
+        for f in glob.glob(os.path.join(here, "BENCH_r*.json"))
+        if (m := re.search(r"BENCH_r(\d+)\.json$", f))
+    ]
+    nn = (max(rounds) + 1) if rounds else 1
+    return os.path.join(here, f"BENCH_full_r{nn:02d}.jsonl")
+
+
+def emit(line: dict) -> None:
+    """Print a bench row AND append it to the full-row artifact."""
+    s = json.dumps(line)
+    print(s, flush=True)
+    if _FULL_RECORD == ["unset"]:
+        _FULL_RECORD[:] = [_full_record_path()]
+    path = _FULL_RECORD[0]
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(s + "\n")
+        except OSError:
+            pass  # an unwritable record must not kill the sweep
+
+
 # ms/batch, 1×K40m (BASELINE.md)
 BASELINES_MS = {
     "alexnet_bs64": 195.0,
@@ -1003,12 +1049,12 @@ def main(argv):
             health, rtt_ms = probe
         floor_ms = dispatch_floor_probe()
     except Exception as e:
-        print(json.dumps({
+        emit({
             "metric": "chip_health",
             "error": f"{type(e).__name__}: {e}"[:200],
-        }), flush=True)
+        })
     else:
-        print(json.dumps({
+        emit({
             "metric": "chip_health",
             "value": None if health is None else round(health, 1),
             "unit": "TFLOP/s (latency-cancelled chained bf16 matmul)",
@@ -1018,7 +1064,7 @@ def main(argv):
             ),
             "healthy_threshold": HEALTHY_TFLOPS,
             "note": "None = not on TPU",
-        }), flush=True)
+        })
     throttled = health is not None and health < HEALTHY_TFLOPS
     failures = 0
     north = {}
@@ -1029,11 +1075,11 @@ def main(argv):
         elapsed = time.monotonic() - t_start
         if elapsed > budget_s:
             skipped.append(name)
-            print(json.dumps({
+            emit({
                 "metric": name, "skipped": "budget",
                 "elapsed_s": round(elapsed, 1),
                 "budget_s": budget_s,
-            }), flush=True)
+            })
             continue
         line = {"metric": name}
         try:
@@ -1050,7 +1096,7 @@ def main(argv):
                 # absolute times unreliable; only interleaved A/B
                 # ratio fields (fused_speedup etc.) stay trustworthy
                 line["throttled"] = True
-        print(json.dumps(line), flush=True)
+        emit(line)
         if name in NORTH_STARS:
             north[name] = {
                 "value": line.get("value"),
@@ -1066,7 +1112,7 @@ def main(argv):
                 north[name]["error"] = line["error"][:80]
     # Compact trailer: repeats the headline so a bounded tail capture
     # still records it even after the full matrix has printed.
-    print(json.dumps({
+    emit({
         "metric": "summary",
         "north_stars": north,
         "health_tflops": None if health is None else round(health, 1),
@@ -1074,7 +1120,7 @@ def main(argv):
         "rows_skipped_budget": skipped,
         "failures": failures,
         "elapsed_s": round(time.monotonic() - t_start, 1),
-    }), flush=True)
+    })
     return 1 if failures else 0
 
 

@@ -87,6 +87,7 @@ def _ensure_devices(pattern):
 
 
 from bench import _setup  # one source of truth for AMP/PRNG/cache setup
+from bench import emit  # every row also lands in BENCH_full_rNN.jsonl
 
 
 def _mesh_arm(conf, feed, opt_conf, mesh, iters):
@@ -244,6 +245,167 @@ def _bench_row(model, total_bs, n_dev, synthetic):
     return out
 
 
+def _bench_checkpoint_overhead(n_dev, synthetic):
+    """Per-step cost of checkpointing at a fixed cadence, sync vs
+    async (ROADMAP item 4: pod-scale snapshots must not stall
+    training). Three arms over the same mesh-sharded program:
+
+      base   — no saves (the floor)
+      sync   — blocking `checkpoint.save_pass` every `cadence` steps
+               (device_get + serialize + write on the training thread)
+      async  — `AsyncCheckpointer.save` at the same cadence (only the
+               host snapshot blocks; serialize + atomic write overlap
+               the next steps)
+
+    Headline `value` = mean training-thread stall per async save;
+    `sync_save_ms` is what the same save costs when synchronous. The
+    CPU-mesh smoke asserts async stall < sync save — the contract that
+    makes async mode worth shipping."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.core.mesh import DATA_AXIS, make_mesh
+    from paddle_tpu.network import Network
+    from paddle_tpu.optimizers import create_optimizer
+    from paddle_tpu.parallel.dp import TrainStep, shard_batch
+    from paddle_tpu.trainer import checkpoint as ckpt
+    from paddle_tpu.trainer import async_checkpoint as actp
+
+    if synthetic:
+        bs, t, steps, cadence = 2 * n_dev, 16, 8, 2
+    else:
+        bs, t, steps, cadence = 8 * n_dev, 64, 30, 5
+    # the 30k-vocab embedding makes the checkpoint tens of MB — a save
+    # whose serialize+write cost is visible against the step time
+    conf, feed = _lstm_conf_feed(256, bs, t=t)
+    opt_conf = OptimizationConf(learning_method="adam",
+                                learning_rate=2e-3)
+    mesh = make_mesh({DATA_AXIS: n_dev})
+
+    net = Network(conf)
+    params = net.init_params(jax.random.key(0))
+    opt = create_optimizer(opt_conf, net.param_confs)
+    step = TrainStep(net, opt, mesh=mesh, donate=False)
+    st = {
+        "params": params,
+        "opt_state": opt.init_state(params),
+        "state": net.init_state(),
+        "i": 0,
+    }
+    st["params"], st["opt_state"], st["state"] = step.place(
+        st["params"], st["opt_state"], st["state"]
+    )
+    feed = shard_batch(feed, mesh)
+    key = jax.random.key(1)
+
+    def one_step():
+        (
+            st["params"], st["opt_state"], st["state"], loss, _o,
+        ) = step(
+            st["params"], st["opt_state"], st["state"], feed,
+            st["i"], key,
+        )
+        st["i"] += 1
+        return float(loss)  # scalar fetch forces execution
+
+    one_step()
+    one_step()  # warm both the program and the dispatch path
+    ckpt_bytes = sum(
+        a.nbytes for a in actp.snapshot_shards(
+            {"params": st["params"], "opt_state": st["opt_state"]}
+        ).values()
+    )
+
+    def run_arm(save_fn):
+        """Returns (ms_per_step over the loop, mean ms per save)."""
+        stalls = []
+        t0 = time.perf_counter()
+        for i in range(steps):
+            one_step()
+            if save_fn is not None and (i + 1) % cadence == 0:
+                s0 = time.perf_counter()
+                save_fn((i + 1) // cadence)
+                stalls.append(time.perf_counter() - s0)
+        total = time.perf_counter() - t0
+        stall_ms = (
+            sum(stalls) / len(stalls) * 1e3 if stalls else 0.0
+        )
+        return total / steps * 1e3, stall_ms
+
+    base_ms, _ = run_arm(None)
+
+    sync_dir = tempfile.mkdtemp(prefix="bench_ckpt_sync_")
+    async_dir = tempfile.mkdtemp(prefix="bench_ckpt_async_")
+    try:
+        def sync_save(pass_id):
+            ckpt.save_pass(
+                sync_dir, pass_id,
+                jax.device_get(st["params"]),
+                jax.device_get(st["opt_state"]),
+                jax.device_get(st["state"]),
+                meta={"global_step": st["i"]},
+            )
+
+        sync_ms, sync_save_ms = run_arm(sync_save)
+
+        writer = actp.AsyncCheckpointer(async_dir, keep_last=2)
+
+        def async_save(pass_id):
+            writer.save(
+                pass_id, st["params"], st["opt_state"], st["state"],
+                meta={"global_step": st["i"]},
+            )
+
+        async_ms, async_stall_ms = run_arm(async_save)
+        d0 = time.perf_counter()
+        writer.close()  # drain; surfaces any background write error
+        drain_ms = (time.perf_counter() - d0) * 1e3
+        # the drained checkpoints really committed (manifest-complete,
+        # checksums verified) — reported on the row so the smoke can
+        # assert it, raised here so a silent writer can't score a row
+        committed = [
+            p for p in actp.list_passes(async_dir)
+            if actp.verify_pass(async_dir, p)[0]
+        ]
+        if not committed:
+            raise RuntimeError(
+                "async writer committed no complete pass"
+            )
+    finally:
+        shutil.rmtree(sync_dir, ignore_errors=True)
+        shutil.rmtree(async_dir, ignore_errors=True)
+
+    out = {
+        "value": round(async_stall_ms, 3),
+        "unit": "ms training-thread stall per async save",
+        "sync_save_ms": round(sync_save_ms, 3),
+        "async_stall_ms": round(async_stall_ms, 3),
+        "stall_vs_sync": round(
+            async_stall_ms / sync_save_ms, 3
+        ) if sync_save_ms else None,
+        "base_ms_per_step": round(base_ms, 3),
+        "sync_ms_per_step": round(sync_ms, 3),
+        "async_ms_per_step": round(async_ms, 3),
+        "async_drain_ms": round(drain_ms, 3),
+        "async_committed_passes": len(committed),
+        "save_cadence_steps": cadence,
+        "steps": steps,
+        "checkpoint_mb": round(ckpt_bytes / 1e6, 1),
+        "devices": n_dev,
+        "total_batch": bs,
+    }
+    if synthetic:
+        out["synthetic"] = True
+        out["note"] = (
+            "host-CPU virtual mesh smoke - stall RATIO is the claim, "
+            "absolute times are not"
+        )
+    return out
+
+
 def build_rows(n_dev):
     rows = []
     for model in ("alexnet", "googlenet"):
@@ -269,31 +431,42 @@ def mc_main(argv):
     t_start = time.monotonic()
     import jax
 
-    print(json.dumps({
+    emit({
         "metric": "mc_config",
         "devices": n_dev,
         "platform": jax.devices()[0].platform,
         "synthetic": synthetic,
-    }), flush=True)
+    })
     failures = 0
-    for name, model, total in build_rows(n_dev):
+    rows = [
+        (name, lambda m=model, t=total: _bench_row(m, t, n_dev,
+                                                   synthetic))
+        for name, model, total in build_rows(n_dev)
+    ]
+    # permanent elasticity row (ROADMAP item 4): checkpoint stalls are
+    # tracked like MFU, not assumed away
+    rows.append((
+        f"mc_checkpoint_overhead_dp{n_dev}",
+        lambda: _bench_checkpoint_overhead(n_dev, synthetic),
+    ))
+    for name, fn in rows:
         if pattern and pattern not in name:
             continue
         elapsed = time.monotonic() - t_start
         if elapsed > budget_s:
-            print(json.dumps({
+            emit({
                 "metric": name, "skipped": "budget",
                 "elapsed_s": round(elapsed, 1),
-            }), flush=True)
+            })
             continue
         line = {"metric": name}
         try:
-            line.update(_bench_row(model, total, n_dev, synthetic))
+            line.update(fn())
         except Exception as e:
             failures += 1
             line["error"] = f"{type(e).__name__}: {e}"[:300]
             line["value"] = None
-        print(json.dumps(line), flush=True)
+        emit(line)
     return 1 if failures else 0
 
 

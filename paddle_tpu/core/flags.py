@@ -29,6 +29,11 @@ _DEFAULTS: dict[str, Any] = {
     "save_dir": None,
     "saving_period": 1,
     "save_only_one": False,
+    # "sync": training stalls for the whole serialize+write;
+    # "async": only the device->host snapshot blocks, serialization and
+    # the atomic-rename shard write overlap the next pass
+    # (trainer/async_checkpoint.py)
+    "checkpoint_mode": "sync",
     "start_pass": 0,
     # data
     "prefetch_depth": 2,

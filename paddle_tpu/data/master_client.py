@@ -8,18 +8,47 @@ lease chunk tasks, and report done/failed. `MasterClient` duck-types
 works with either — in-process for single-host, networked for
 multi-host fault tolerance.
 
-Resilience: every call reconnects and retries with backoff for up to
-`retry_seconds` (the master may be restarting from its snapshot —
-go/master/service.go:166-207 recovery). Lease state lives on the
-server, so a client reconnect does not lose or duplicate tasks.
+Resilience: every call reconnects and retries for up to
+`retry_seconds` with capped exponential backoff and FULL JITTER
+(delay ~ U(0, min(cap, base*2^attempt)) — decorrelates a thundering
+herd of trainers hammering a restarting master). Connection-shaped
+errors (refused/reset/EOF/timeout: the master is restarting from its
+snapshot, go/master/service.go:166-207) retry; malformed frames are a
+`MasterProtocolError` and fail fast — retrying a peer that speaks the
+wrong protocol only hides a real bug. When the deadline expires the
+caller gets a `MasterRetryTimeout` naming the address, elapsed time
+and attempt count instead of a generic socket error. Lease state
+lives on the server, so a client reconnect does not lose or duplicate
+tasks.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import time
 from typing import Optional
+
+_BACKOFF_BASE = 0.05
+_BACKOFF_CAP = 1.0
+_MAX_FRAME = 1 << 30  # >1GiB response length = garbage, not a frame
+
+
+class MasterError(Exception):
+    """Base for master-client failures."""
+
+
+class MasterProtocolError(MasterError):
+    """The peer answered with a malformed frame. NOT retried: the
+    master is alive but speaking garbage (version skew, wrong port) —
+    reconnecting cannot fix it."""
+
+
+class MasterRetryTimeout(MasterError, ConnectionError):
+    """The master stayed unreachable for the whole retry budget.
+    Subclasses ConnectionError so pre-existing `except ConnectionError`
+    callers (ping, elastic readers) keep working."""
 
 _OP_ADD_TASK = 1
 _OP_GET_TASK = 2
@@ -74,6 +103,14 @@ class MasterClient:
         frame = struct.pack("<IB", 1 + len(body), op) + body
         self._sock.sendall(frame)
         (rlen,) = struct.unpack("<I", self._recv_full(4))
+        if rlen < 8 or rlen > _MAX_FRAME:
+            # too short to carry a status / absurdly long: not our
+            # protocol — poison the connection and fail fast
+            self.close()
+            raise MasterProtocolError(
+                f"master at {self._host}:{self._port} sent a malformed "
+                f"frame (length {rlen})"
+            )
         resp = self._recv_full(rlen)
         (status,) = struct.unpack("<q", resp[:8])
         return status, resp[8:]
@@ -89,18 +126,35 @@ class MasterClient:
         duplicate GET_TASK just leases another task; a duplicate
         ADD_TASK can enqueue a chunk twice, which costs one redundant
         task but never corrupts pass accounting (the duplicate is its
-        own task with its own done entry)."""
-        deadline = time.monotonic() + self._retry
-        delay = 0.05
+        own task with its own done entry).
+
+        Connection errors retry with capped full-jitter backoff until
+        `retry_seconds`, then raise MasterRetryTimeout; malformed
+        frames raise MasterProtocolError immediately."""
+        start = time.monotonic()
+        deadline = start + self._retry
+        attempt = 0
         while True:
             try:
                 return self._call_once(op, body)
-            except (OSError, ConnectionError):
+            except MasterProtocolError:
+                raise  # alive-but-wrong peer: retrying hides the bug
+            except (OSError, ConnectionError) as e:
                 self.close()
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 1.0)
+                now = time.monotonic()
+                if now >= deadline:
+                    raise MasterRetryTimeout(
+                        f"master at {self._host}:{self._port} "
+                        f"unreachable for {now - start:.1f}s "
+                        f"({attempt + 1} attempts, retry_seconds="
+                        f"{self._retry}); last error: "
+                        f"{type(e).__name__}: {e}"
+                    ) from e
+                # full jitter: U(0, min(cap, base*2^attempt)), clipped
+                # to the remaining budget so the deadline is honored
+                ceil = min(_BACKOFF_CAP, _BACKOFF_BASE * (2 ** attempt))
+                time.sleep(min(random.uniform(0, ceil), deadline - now))
+                attempt += 1
 
     def close(self):
         if self._sock is not None:

@@ -7,9 +7,12 @@ PIL-based here (the reference uses cv2)."""
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 __all__ = [
+    "batch_images_from_tar",
     "load_image",
     "load_image_bytes",
     "resize_short",
@@ -20,6 +23,62 @@ __all__ = [
     "simple_transform",
     "load_and_transform",
 ]
+
+
+def batch_images_from_tar(
+    data_file: str,
+    dataset_name: str,
+    img2label: dict,
+    num_per_batch: int = 1024,
+) -> str:
+    """Read images from a tar file and group them into pickled batch
+    files (reference python/paddle/v2/image.py batch_images_from_tar):
+    each batch file holds {"label": [...], "data": [raw bytes, ...]}
+    for up to `num_per_batch` members whose tar name appears in
+    `img2label`. Batches land in `<data_file>_batch/<dataset_name>/`;
+    returns the path of a meta file listing one batch-file path per
+    line. An existing batch dir is reused (the reference's resume
+    behavior)."""
+    import pickle
+    import tarfile
+
+    batch_dir = data_file + "_batch"
+    out_path = os.path.join(batch_dir, dataset_name)
+    meta_file = os.path.join(batch_dir, dataset_name + ".txt")
+    if os.path.exists(out_path):
+        return meta_file
+    os.makedirs(out_path)
+
+    labels, data = [], []
+    file_id = 0
+
+    def flush():
+        nonlocal file_id, labels, data
+        with open(
+            os.path.join(out_path, f"batch_{file_id}"), "wb"
+        ) as f:
+            pickle.dump({"label": labels, "data": data}, f,
+                        protocol=2)
+        file_id += 1
+        labels, data = [], []
+
+    with tarfile.open(data_file) as tf:
+        for mem in tf.getmembers():
+            if mem.name not in img2label:
+                continue
+            data.append(tf.extractfile(mem).read())
+            labels.append(img2label[mem.name])
+            if len(data) == num_per_batch:
+                flush()
+    if data:
+        flush()
+
+    with open(meta_file, "w") as meta:
+        for name in sorted(os.listdir(out_path)):
+            meta.write(
+                os.path.abspath(os.path.join(out_path, name)) + "\n"
+            )
+    return meta_file
 
 
 def load_image_bytes(data: bytes, is_color: bool = True) -> np.ndarray:

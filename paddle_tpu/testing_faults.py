@@ -1,0 +1,223 @@
+"""Fault-injection harness for the elastic-training test suite.
+
+Reproducing the reference's fault-tolerance story (a trainer SIGKILLed
+mid-task re-leases through the Go master, go/master/service.go:313; a
+master restart recovers from its snapshot, service.go:166-207) requires
+*injecting* those faults deterministically. This module is the one
+place the tests get their violence from:
+
+- `kill_process`: SIGKILL a worker/master subprocess (no cleanup, no
+  atexit — the honest crash).
+- `FlakyProxy`: a TCP proxy in front of the master that can refuse,
+  reset (RST via SO_LINGER 0), delay, or cut connections on command —
+  drives the master-client retry/backoff tests without racing a real
+  master restart.
+- `truncate_file` / `corrupt_file`: tear or bit-flip a checkpoint
+  shard to exercise manifest rejection and fallback.
+
+Test-support code, but shipped in the package (like the reference's
+paddle/cuda stubs) so downstream users can fault-test their own
+deployments.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import struct
+import threading
+
+
+def kill_process(proc) -> None:
+    """SIGKILL a subprocess.Popen and reap it. The process gets no
+    chance to flush, ack, or release leases — exactly the crash the
+    elastic master must absorb."""
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGKILL)
+    proc.wait()
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> int:
+    """Truncate `path` to `keep_fraction` of its size (a torn write /
+    partial flush at crash). Returns the new size."""
+    size = os.path.getsize(path)
+    keep = int(size * keep_fraction)
+    with open(path, "r+b") as f:
+        f.truncate(keep)
+    return keep
+
+
+def corrupt_file(path: str, offset: int = None, nbytes: int = 8) -> None:
+    """Flip bits in-place (silent media corruption — same size, wrong
+    payload). Defaults to the middle of the file."""
+    size = os.path.getsize(path)
+    if offset is None:
+        offset = size // 2
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+class FlakyProxy:
+    """TCP proxy with programmable connection faults.
+
+    Sits between a master client and the real master:
+
+        proxy = FlakyProxy(("127.0.0.1", master_port))
+        client = MasterClient(f"127.0.0.1:{proxy.port}")
+        proxy.reset_next(3)   # next 3 connections get RST mid-call
+        proxy.refuse_all()    # then: connect() succeeds, dies instantly
+        proxy.heal()          # back to transparent forwarding
+
+    Faults are applied per accepted connection, so a client with
+    reconnect-and-retry semantics sees exactly N failures and then a
+    clean master — the deterministic version of "the master is
+    restarting"."""
+
+    def __init__(self, target: tuple, listen_host: str = "127.0.0.1"):
+        self._target = target
+        self._lock = threading.Lock()
+        self._reset_budget = 0  # connections to RST after the request
+        self._refuse = False  # close every connection immediately
+        self._delay_s = 0.0  # added latency before forwarding starts
+        self._conns: list = []
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, 0))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="flaky-proxy", daemon=True
+        )
+        self._thread.start()
+
+    # ---- fault programming ----
+    def reset_next(self, n: int = 1) -> None:
+        """RST the next `n` connections right after they send data."""
+        with self._lock:
+            self._reset_budget = n
+
+    def refuse_all(self) -> None:
+        """Kill every new connection immediately after accept — the
+        observable shape of a master that is down/restarting."""
+        with self._lock:
+            self._refuse = True
+
+    def delay(self, seconds: float) -> None:
+        with self._lock:
+            self._delay_s = seconds
+
+    def heal(self) -> None:
+        with self._lock:
+            self._refuse = False
+            self._reset_budget = 0
+            self._delay_s = 0.0
+
+    def cut_existing(self) -> None:
+        """RST every currently-open proxied connection (network
+        partition for in-flight calls)."""
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            _rst_close(s)
+
+    # ---- plumbing ----
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                refuse = self._refuse
+                reset = self._reset_budget > 0
+                if reset:
+                    self._reset_budget -= 1
+                delay_s = self._delay_s
+            if refuse:
+                _rst_close(client)
+                continue
+            threading.Thread(
+                target=self._serve,
+                args=(client, reset, delay_s),
+                daemon=True,
+            ).start()
+
+    def _serve(self, client: socket.socket, reset: bool, delay_s: float):
+        try:
+            upstream = socket.create_connection(self._target, timeout=5)
+        except OSError:
+            _rst_close(client)
+            return
+        with self._lock:
+            self._conns += [client, upstream]
+        if reset:
+            # let exactly one request through to the wire, then RST the
+            # client before the response lands: the retried call is the
+            # at-least-once duplicate the protocol must absorb
+            try:
+                data = client.recv(65536)
+                if data:
+                    upstream.sendall(data)
+                    if delay_s:
+                        threading.Event().wait(delay_s)
+            except OSError:
+                pass
+            _rst_close(client)
+            _rst_close(upstream)
+            return
+        if delay_s:
+            threading.Event().wait(delay_s)
+        t = threading.Thread(
+            target=_pump, args=(client, upstream), daemon=True
+        )
+        t.start()
+        _pump(upstream, client)
+
+    def close(self):
+        self._stopped = True
+        try:
+            self._listener.close()
+        finally:
+            self.cut_existing()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _rst_close(s: socket.socket) -> None:
+    """Close sending RST instead of FIN (SO_LINGER 0) — the peer's
+    blocked recv fails with ECONNRESET instead of a clean EOF."""
+    try:
+        s.setsockopt(
+            socket.SOL_SOCKET, socket.SO_LINGER, struct.pack("ii", 1, 0)
+        )
+    except OSError:
+        pass
+    try:
+        s.close()
+    except OSError:
+        pass
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    try:
+        while True:
+            data = src.recv(65536)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        try:
+            dst.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass

@@ -5,4 +5,8 @@ from paddle_tpu.trainer.events import (  # noqa: F401
     EndPass,
     TestResult,
 )
+from paddle_tpu.trainer.async_checkpoint import (  # noqa: F401
+    AsyncCheckpointer,
+    AsyncCheckpointError,
+)
 from paddle_tpu.trainer.trainer import SGD  # noqa: F401

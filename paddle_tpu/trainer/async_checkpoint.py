@@ -1,0 +1,533 @@
+"""Asynchronous, overlapped sharded checkpointing.
+
+The synchronous `checkpoint.save_pass` stalls training for the whole
+device_get + serialize + write; at pod scale that stall is the
+difference between elastic training and a training-time tax on every
+snapshot (the reference's Go pserver checkpoints each shard from its
+own goroutine for the same reason, go/pserver/service.go:76-126).
+Here the only training-blocking work is the device->host snapshot;
+serialization and the atomic-rename write happen on a background
+thread behind a bounded queue.
+
+Format (`async-shard-v1`) — one directory per pass:
+
+    save_dir/pass-00007/
+        manifest.json        # {"pass_id", "num_shards", "meta", ...}
+                             # written by process 0
+        shard-p0.npz         # process 0's addressable shards,
+                             # keys "<tree path>##<device id>"
+        shard-p0.ok.json     # per-shard commit record: keys, nbytes,
+                             # sha256 — written AFTER the npz rename
+        shard-p1.npz ...     # one pair per process
+
+A pass is COMPLETE iff the manifest exists and every shard it names
+has a matching `.ok.json` whose checksum verifies. Every file lands
+via write-to-tmp + `os.replace`, so a SIGKILL at any instant leaves
+either the previous complete pass or an incomplete new one — never a
+loadable-looking lie. Torn or truncated shards fail the checksum and
+the loader falls back to the newest older pass that verifies.
+
+Failure contract: the background writer never lets an exception vanish
+in a daemon thread. The first error is latched; the next `save()` or
+`wait()` re-raises it as `AsyncCheckpointError`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import re
+import shutil
+import threading
+
+import jax
+import numpy as np
+
+from paddle_tpu.trainer.checkpoint import _unflatten, _walk_arrays
+
+MANIFEST = "manifest.json"
+FORMAT = "async-shard-v1"
+_PASS_RE = re.compile(r"^pass-(\d{5})$")
+
+
+class AsyncCheckpointError(RuntimeError):
+    """A background checkpoint write failed (re-raised on the caller)."""
+
+
+def _pass_dir(save_dir: str, pass_id: int) -> str:
+    return os.path.join(save_dir, f"pass-{pass_id:05d}")
+
+
+def _shard_name(process_index: int) -> str:
+    return f"shard-p{process_index}.npz"
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while chunk := f.read(1 << 20):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+INDEX_KEY = "__shard_index__"  # reserved payload entry, JSON as uint8
+
+
+def _index_sig(index, shape) -> list:
+    """Canonical JSON-able [[start, stop], ...] for a shard's slice
+    tuple (None bounds resolved against the global shape)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        out.append([sl.start or 0,
+                    dim if sl.stop is None else sl.stop])
+    return out
+
+
+def snapshot_shards(tree) -> dict:
+    """Device->host snapshot of this process's addressable shards of a
+    (possibly globally sharded) pytree — the only part of an async save
+    that blocks training.
+
+    Keys: `<tree path>##<device id>` for genuinely sharded arrays —
+    ONE entry per DISTINCT shard index, so replicas (full or partial,
+    e.g. replicated over the data axis while sharded over the model
+    axis) are never copied twice; `<tree path>##r<process index>` for
+    arrays with a single distinct shard on this process. That dedup is
+    what keeps the training-blocking stall flat as the mesh grows (a
+    DP-replicated model on 8 devices would otherwise snapshot 8x the
+    bytes).
+
+    Sharded entries also record their exact global shape + slice in a
+    reserved `__shard_index__` payload entry, so loaders reassemble by
+    slice assignment — any sharding layout, not just axis-0 rows."""
+    payload = {}
+    idxmeta = {}
+    rtag = f"r{jax.process_index()}"
+    for name, arr in _walk_arrays(tree).items():
+        if not hasattr(arr, "addressable_shards"):
+            arr = jax.numpy.asarray(arr)
+        distinct = {}  # index signature -> shard (first replica wins)
+        for sh in arr.addressable_shards:
+            sig = tuple(
+                tuple(p) for p in _index_sig(sh.index, arr.shape)
+            )
+            distinct.setdefault(sig, sh)
+        if len(distinct) == 1:
+            sh = next(iter(distinct.values()))
+            payload[f"{name}##{rtag}"] = np.asarray(sh.data)
+        else:
+            entries = {}
+            for sig, sh in distinct.items():
+                payload[f"{name}##{sh.device.id}"] = np.asarray(sh.data)
+                entries[str(sh.device.id)] = [list(p) for p in sig]
+            idxmeta[name] = {
+                "global_shape": list(arr.shape),
+                "index": entries,
+            }
+    if idxmeta:
+        payload[INDEX_KEY] = np.frombuffer(
+            json.dumps(idxmeta).encode(), np.uint8
+        ).copy()
+    return payload
+
+
+def write_shard(save_dir: str, pass_id: int, payload: dict,
+                meta=None, num_shards: int = None,
+                process_index: int = None) -> str:
+    """Commit one process's shard of `pass_id` (atomic npz + .ok.json
+    checksum sidecar); process 0 also writes the manifest. Safe to call
+    from any thread/process; used by both the async writer thread and
+    synchronous callers that want the manifested format."""
+    pidx = jax.process_index() if process_index is None else process_index
+    nsh = jax.process_count() if num_shards is None else num_shards
+    d = _pass_dir(save_dir, pass_id)
+    os.makedirs(d, exist_ok=True)
+    shard = os.path.join(d, _shard_name(pidx))
+    # savez appends ".npz" to a name without it; stage, fsync, rename
+    tmp = shard[:-4] + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, shard)
+    _atomic_write_json(shard[:-4] + ".ok.json", {
+        "keys": sorted(payload),
+        "nbytes": os.path.getsize(shard),
+        "sha256": _sha256(shard),
+    })
+    if pidx == 0:
+        _atomic_write_json(os.path.join(d, MANIFEST), {
+            "format": FORMAT,
+            "pass_id": pass_id,
+            "num_shards": nsh,
+            "meta": dict(meta or {}),
+        })
+    return d
+
+
+def list_passes(save_dir: str) -> list:
+    """Manifested pass ids, ascending (staging/.tmp names excluded)."""
+    if not os.path.isdir(save_dir):
+        return []
+    out = []
+    for name in os.listdir(save_dir):
+        m = _PASS_RE.match(name)
+        if m and os.path.exists(
+            os.path.join(save_dir, name, MANIFEST)
+        ):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def verify_pass(save_dir: str, pass_id: int) -> tuple:
+    """(ok, reason). A pass verifies iff the manifest exists and every
+    shard it names has an .ok.json whose size and sha256 match the npz
+    on disk — a torn/truncated shard fails here, not at np.load."""
+    d = _pass_dir(save_dir, pass_id)
+    try:
+        with open(os.path.join(d, MANIFEST)) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"manifest unreadable: {e}"
+    if man.get("format") != FORMAT:
+        return False, f"unknown format {man.get('format')!r}"
+    for i in range(man["num_shards"]):
+        shard = os.path.join(d, _shard_name(i))
+        ok_path = shard[:-4] + ".ok.json"
+        try:
+            with open(ok_path) as f:
+                ok = json.load(f)
+        except (OSError, ValueError):
+            return False, f"shard {i}: missing/unreadable {ok_path}"
+        if not os.path.exists(shard):
+            return False, f"shard {i}: npz missing"
+        if os.path.getsize(shard) != ok["nbytes"]:
+            return False, (
+                f"shard {i}: size {os.path.getsize(shard)} != "
+                f"committed {ok['nbytes']} (truncated?)"
+            )
+        if _sha256(shard) != ok["sha256"]:
+            return False, f"shard {i}: checksum mismatch (corrupt)"
+    return True, "ok"
+
+
+def latest_complete_pass(save_dir: str) -> int:
+    """Newest pass id that verifies, or -1. Incomplete/torn passes are
+    skipped with a warning — the fall-back-to-previous-pass semantics
+    of the reference's snapshot recovery (go/master/service.go:166)."""
+    import logging
+
+    for pid in reversed(list_passes(save_dir)):
+        ok, reason = verify_pass(save_dir, pid)
+        if ok:
+            return pid
+        logging.getLogger("paddle_tpu.trainer").warning(
+            "checkpoint pass-%05d rejected (%s); falling back",
+            pid, reason,
+        )
+    return -1
+
+
+def merge_npz_shards(paths) -> tuple:
+    """Host-side merge of shard npz files. Returns
+    (flat {tree key ##tag -> np}, index metadata {name -> {"global_shape",
+    "index": {device id -> [[start, stop], ...]}}} unioned across
+    files)."""
+    flat = {}
+    idxmeta: dict = {}
+    for path in paths:
+        with np.load(path) as z:
+            for k in z.files:
+                if k == INDEX_KEY:
+                    meta = json.loads(bytes(z[k]).decode())
+                    for name, m in meta.items():
+                        cur = idxmeta.setdefault(
+                            name,
+                            {"global_shape": m["global_shape"],
+                             "index": {}},
+                        )
+                        cur["index"].update(m["index"])
+                else:
+                    flat[k] = z[k]
+    return flat, idxmeta
+
+
+def _merge_shard_files(d: str, num_shards: int) -> tuple:
+    return merge_npz_shards(
+        os.path.join(d, _shard_name(i)) for i in range(num_shards)
+    )
+
+
+def _assemble_by_index(name: str, flat: dict, meta: dict):
+    """Exact reassembly of one sharded array from its recorded slice
+    map. Verifies full coverage — a shard map that leaves holes (e.g.
+    a process count mismatch) is an error, not silent garbage."""
+    shape = tuple(meta["global_shape"])
+    first = flat[f"{name}##{next(iter(meta['index']))}"]
+    out = np.empty(shape, first.dtype)
+    covered = np.zeros(shape, bool)
+    for dev, sig in meta["index"].items():
+        sl = tuple(slice(a, b) for a, b in sig)
+        out[sl] = flat[f"{name}##{dev}"]
+        covered[sl] = True
+    if not covered.all():
+        raise ValueError(
+            f"shard map for {name!r} does not cover the global shape "
+            f"{shape} ({int(covered.sum())}/{covered.size} elements)"
+        )
+    return out
+
+
+def load_pass(save_dir: str, pass_id: int = -1, template=None):
+    """Load an async-format pass; `pass_id=-1` = newest COMPLETE pass.
+    Returns (tree, meta).
+
+    Without `template`, arrays are reassembled on host: per tree key the
+    per-device entries are concatenated along axis 0 when their shapes
+    tile the way a data/row sharding does, else (replicated) the first
+    entry wins. With `template` (pytree of arrays/ShapeDtypeStructs
+    carrying global shape + sharding), each process device_puts exactly
+    its addressable shards — the multi-host restore path."""
+    if pass_id < 0:
+        pass_id = latest_complete_pass(save_dir)
+        if pass_id < 0:
+            raise FileNotFoundError(
+                f"no complete async checkpoint pass in {save_dir}"
+            )
+    else:
+        ok, reason = verify_pass(save_dir, pass_id)
+        if not ok:
+            raise ValueError(
+                f"checkpoint pass-{pass_id:05d} incomplete: {reason}"
+            )
+    d = _pass_dir(save_dir, pass_id)
+    with open(os.path.join(d, MANIFEST)) as f:
+        man = json.load(f)
+    man["meta"] = {"pass_id": man["pass_id"], **man["meta"]}
+
+    flat, idxmeta = _merge_shard_files(d, man["num_shards"])
+
+    if template is not None:
+        return (
+            assemble_with_template(flat, idxmeta, template),
+            man["meta"],
+        )
+
+    by_name: dict = {}
+    for k, v in flat.items():
+        name, tag = k.rsplit("##", 1)
+        by_name.setdefault(name, []).append((tag, v))
+    out = {}
+    for name, entries in by_name.items():
+        if name in idxmeta:
+            # exact slice map recorded at save time: reassemble any
+            # sharding layout (axis 1, 2D tiles, ...) — never guess
+            out[name] = _assemble_by_index(name, flat, idxmeta[name])
+            continue
+        arrs = [v for _, v in sorted(entries, key=lambda e: e[0])]
+        same = all(a.shape == arrs[0].shape for a in arrs)
+        if len(arrs) == 1 or (same and all(
+            np.array_equal(a, arrs[0]) for a in arrs[1:]
+        )):
+            out[name] = arrs[0]  # replicated (or single shard)
+        elif same and all(e[0].isdigit() for e in entries):
+            # hand-built payload without a slice map (write_shard API
+            # callers): device-id order concatenates along axis 0 —
+            # only row sharding is expressible this way
+            arrs = [
+                v for _, v in sorted(entries, key=lambda e: int(e[0]))
+            ]
+            out[name] = np.concatenate(arrs, axis=0)
+        else:
+            raise ValueError(
+                f"cannot reassemble {name!r} without a template "
+                f"(shapes {[a.shape for a in arrs]})"
+            )
+    return _unflatten(out), man["meta"]
+
+
+def assemble_with_template(flat: dict, idxmeta: dict, template):
+    """Re-place host shard entries onto devices per `template` (a
+    pytree of arrays/ShapeDtypeStructs carrying global shape +
+    sharding). Per target device: its exact saved entry, else the
+    saved shard whose recorded slice equals the device's slice under
+    the template sharding (same-topology restart with renumbered
+    devices), else this process's replicated copy."""
+    rtag = f"r{jax.process_index()}"
+    out = {}
+    for name, t in _walk_arrays(template).items():
+        sharding = t.sharding
+        meta = idxmeta.get(name)
+        sig_to_key = {}
+        if meta:
+            sig_to_key = {
+                tuple(tuple(p) for p in sig): f"{name}##{dev}"
+                for dev, sig in meta["index"].items()
+            }
+            dev_sigs = {
+                dev: tuple(
+                    tuple(p)
+                    for p in _index_sig(idx, tuple(t.shape))
+                )
+                for dev, idx in sharding.addressable_devices_indices_map(
+                    tuple(t.shape)
+                ).items()
+            }
+        bufs = []
+        for dev in sharding.addressable_devices:
+            key = f"{name}##{dev.id}"
+            if key not in flat and meta:
+                key = sig_to_key.get(dev_sigs[dev], key)
+            if key not in flat:
+                key = f"{name}##{rtag}"
+            bufs.append(jax.device_put(flat[key], dev))
+        out[name] = jax.make_array_from_single_device_arrays(
+            t.shape, sharding, bufs
+        )
+    return _unflatten(out)
+
+
+class AsyncCheckpointer:
+    """Overlapped checkpoint writer.
+
+    `save()` blocks only for the device->host snapshot (and for queue
+    backpressure when `queue_depth` saves are already in flight), then
+    returns; a single background thread serializes and commits shards.
+    `wait()` drains the queue and raises the first latched write error.
+    """
+
+    def __init__(self, save_dir: str, keep_last: int = 0,
+                 queue_depth: int = 2):
+        """`keep_last=0` keeps every pass; `keep_last=n` rotates all but
+        the newest n COMPLETE passes (the reference's save_only_one is
+        keep_last=1, trainer/ParamUtil.h:77)."""
+        self.save_dir = save_dir
+        self.keep_last = keep_last
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
+        self._snap_lock = threading.Lock()
+        self._err_lock = threading.Lock()
+        self._last_error: Exception | None = None
+        self._verified: set = set()  # pass ids already proven complete
+        self._thread = threading.Thread(
+            target=self._worker, name="async-ckpt-writer", daemon=True
+        )
+        self._thread.start()
+        self._closed = False
+
+    # ---- error contract ----
+    @property
+    def last_error(self) -> Exception | None:
+        with self._err_lock:
+            return self._last_error
+
+    def _raise_if_failed(self):
+        # surfacing CLEARS the latch: once the caller has seen the
+        # error, the writer is usable again (a transient ENOSPC must
+        # not poison every later run on the same trainer instance)
+        with self._err_lock:
+            err, self._last_error = self._last_error, None
+        if err is not None:
+            raise AsyncCheckpointError(
+                f"background checkpoint write failed: {err!r}"
+            ) from err
+
+    # ---- producer ----
+    def save(self, pass_id: int, params, opt_state=None, state=None,
+             meta=None) -> None:
+        """Snapshot to host and enqueue the write. The tree layout
+        mirrors `checkpoint.save_pass` (params/opt_state/state roots)
+        so loaders can hand back the same triple."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_if_failed()
+        tree = {"params": params}
+        if opt_state is not None:
+            tree["opt_state"] = opt_state
+        if state:
+            tree["state"] = state
+        with self._snap_lock:
+            payload = snapshot_shards(tree)
+        self._q.put((pass_id, payload, dict(meta or {})))
+
+    # ---- consumer ----
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._q.task_done()
+                return
+            pass_id, payload, meta = item
+            try:
+                write_shard(self.save_dir, pass_id, payload, meta=meta)
+                if self.keep_last and jax.process_index() == 0:
+                    self._rotate(pass_id)
+            except Exception as e:  # latch; surface on save()/wait()
+                with self._err_lock:
+                    if self._last_error is None:
+                        self._last_error = e
+            finally:
+                self._q.task_done()
+
+    def _rotate(self, newest_pass: int):
+        """Prune old passes, keeping the newest `keep_last` COMPLETE
+        ones. Never removes a complete pass until enough newer complete
+        ones exist — a crash mid-rotation still leaves a loadable
+        checkpoint. Stale staging litter is swept too.
+
+        Completeness verdicts are memoized: re-hashing every retained
+        checkpoint on every save would make the background writer
+        O(total checkpoint bytes) per save and backpressure the
+        bounded queue into the training thread. (Rotation is not the
+        integrity gate — load re-verifies from disk.)"""
+        complete = []
+        for p in list_passes(self.save_dir):
+            if p not in self._verified and verify_pass(
+                self.save_dir, p
+            )[0]:
+                self._verified.add(p)
+            if p in self._verified:
+                complete.append(p)
+        for pid in complete[: -self.keep_last]:
+            shutil.rmtree(
+                _pass_dir(self.save_dir, pid), ignore_errors=True
+            )
+        for name in os.listdir(self.save_dir):
+            if name.endswith(".tmp") and _PASS_RE.match(name[:-4]):
+                shutil.rmtree(
+                    os.path.join(self.save_dir, name),
+                    ignore_errors=True,
+                )
+
+    # ---- draining ----
+    def wait(self) -> None:
+        """Block until every enqueued save has committed; raise the
+        first background write error if one occurred."""
+        self._q.join()
+        self._raise_if_failed()
+
+    def close(self) -> None:
+        """Drain, stop the writer thread, surface any error."""
+        if self._closed:
+            return
+        self._q.join()
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=60)
+        self._raise_if_failed()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -17,10 +17,13 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import shutil
 
 import jax
 import numpy as np
+
+_PASS_DIR_RE = re.compile(r"^pass-(\d{5})$")
 
 
 def _flatten(tree, prefix=""):
@@ -62,36 +65,82 @@ def save_pass(
     meta=None,
     save_only_one=False,
 ):
+    """Crash-safe: everything is written into a `pass-%05d.tmp/` staging
+    directory and atomically renamed into place, so a SIGKILL mid-save
+    never leaves a loadable-looking partial pass directory — the reader
+    either sees the previous pass or the complete new one. Re-saving an
+    existing pass parks the old dir at `pass-%05d.old` for the duration
+    of the swap; the loader falls back to `.old` if a crash lands
+    between the two renames, so even that window never loses the only
+    checkpoint."""
     if jax.process_index() != 0:
         return None
     d = os.path.join(save_dir, f"pass-{pass_id:05d}")
-    os.makedirs(d, exist_ok=True)
-    _save_npz(os.path.join(d, "params.npz"), params)
+    staging, old = d + ".tmp", d + ".old"
+    shutil.rmtree(staging, ignore_errors=True)  # stale crash litter
+    os.makedirs(staging)
+    _save_npz(os.path.join(staging, "params.npz"), params)
     if opt_state is not None:
-        _save_npz(os.path.join(d, "opt_state.npz"), opt_state)
+        _save_npz(os.path.join(staging, "opt_state.npz"), opt_state)
     if state:
-        _save_npz(os.path.join(d, "state.npz"), state)
-    with open(os.path.join(d, "meta.json"), "w") as f:
+        _save_npz(os.path.join(staging, "state.npz"), state)
+    with open(os.path.join(staging, "meta.json"), "w") as f:
         json.dump({"pass_id": pass_id, **(meta or {})}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    if os.path.isdir(d):  # re-save of the same pass: two-rename swap
+        shutil.rmtree(old, ignore_errors=True)
+        os.replace(d, old)
+    os.replace(staging, d)
+    # committed: sweep the parked old copy (also heals a stale .old
+    # left by a crash mid-swap on a previous run)
+    shutil.rmtree(old, ignore_errors=True)
     if save_only_one:
         for name in os.listdir(save_dir):
-            if name.startswith("pass-") and name != f"pass-{pass_id:05d}":
+            base = name
+            for suf in (".tmp", ".old"):
+                if name.endswith(suf):
+                    base = name[: -len(suf)]
+            if base != f"pass-{pass_id:05d}" and _PASS_DIR_RE.match(base):
                 shutil.rmtree(os.path.join(save_dir, name), ignore_errors=True)
     return d
+
+
+def _resolve_pass_dir(save_dir: str, pass_id: int):
+    """The directory to read pass `pass_id` from: the committed dir,
+    else its `.old` sibling (crash mid re-save swap), else None."""
+    d = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    for cand in (d, d + ".old"):
+        if os.path.exists(os.path.join(cand, "meta.json")):
+            return cand
+    return None
+
+
+def list_sync_passes(save_dir: str) -> list:
+    """Completed sync pass ids, ascending — `.tmp` staging dirs from an
+    interrupted save are not passes, but a `.old` dir orphaned by a
+    crash mid re-save swap still counts (the loader reads it)."""
+    if not os.path.isdir(save_dir):
+        return []
+    out = set()
+    for n in os.listdir(save_dir):
+        base = n[:-4] if n.endswith(".old") else n
+        m = _PASS_DIR_RE.match(base)
+        if m and _resolve_pass_dir(save_dir, int(m.group(1))):
+            out.add(int(m.group(1)))
+    return sorted(out)
 
 
 def load_pass(save_dir: str, pass_id: int = -1):
     """pass_id=-1 loads the latest. Returns (params, opt_state, state, meta)."""
     if pass_id < 0:
-        passes = sorted(
-            int(n.split("-")[1])
-            for n in os.listdir(save_dir)
-            if n.startswith("pass-")
-        )
+        passes = list_sync_passes(save_dir)
         if not passes:
             raise FileNotFoundError(f"no pass-* checkpoints in {save_dir}")
         pass_id = passes[-1]
-    d = os.path.join(save_dir, f"pass-{pass_id:05d}")
+    d = _resolve_pass_dir(save_dir, pass_id) or os.path.join(
+        save_dir, f"pass-{pass_id:05d}"
+    )
     params = _load_npz(os.path.join(d, "params.npz"))
     opt_state = state = None
     if os.path.exists(os.path.join(d, "opt_state.npz")):
@@ -172,7 +221,11 @@ def load_merged(path: str):
 # Every process saves ITS addressable shards and restores them on
 # restart — the Go pserver's per-shard checkpoint/recover intent
 # (go/pserver/service.go:76-126: each pserver checkpoints its own
-# parameter shard; recovery reassembles the global state).
+# parameter shard; recovery reassembles the global state). The
+# snapshot/assemble machinery is shared with the async manifested
+# format (trainer/async_checkpoint.py): same key scheme, same
+# replication dedup, same exact slice-map reassembly — this is the
+# bare per-process flavor without manifest/checksums/rotation.
 
 
 def _walk_arrays(tree, prefix=""):
@@ -187,21 +240,23 @@ def _walk_arrays(tree, prefix=""):
 
 def save_sharded(save_dir: str, tree, tag: str = "ckpt") -> str:
     """Write this process's addressable shards of a (possibly globally
-    sharded) pytree. Call from EVERY process; each writes
-    `{tag}.p{process_index}.npz` keyed `<name>##<device_id>`."""
+    sharded) pytree. Call from EVERY process; each atomically commits
+    `{tag}.p{process_index}.npz` (keys `<name>##<device id>` /
+    `<name>##r<process>`, plus the slice map — see
+    async_checkpoint.snapshot_shards)."""
+    from paddle_tpu.trainer import async_checkpoint as actp
+
     os.makedirs(save_dir, exist_ok=True)
-    payload = {}
-    for name, arr in _walk_arrays(tree).items():
-        arr = jax.numpy.asarray(arr) if not hasattr(
-            arr, "addressable_shards"
-        ) else arr
-        for sh in arr.addressable_shards:
-            payload[f"{name}##{sh.device.id}"] = np.asarray(sh.data)
+    payload = actp.snapshot_shards(tree)
     path = os.path.join(
         save_dir, f"{tag}.p{jax.process_index()}.npz"
     )
-    np.savez(path[:-4] + ".tmp", **payload)  # savez appends .npz
-    os.replace(path[:-4] + ".tmp.npz", path)
+    tmp = path[:-4] + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     return path
 
 
@@ -209,22 +264,13 @@ def load_sharded(save_dir: str, template, tag: str = "ckpt"):
     """Restore this process's shards written by `save_sharded` and
     reassemble global arrays. `template` is a pytree of arrays (or
     ShapeDtypeStructs) carrying the target global shape + sharding."""
+    from paddle_tpu.trainer import async_checkpoint as actp
+
     path = os.path.join(
         save_dir, f"{tag}.p{jax.process_index()}.npz"
     )
-    flat_t = _walk_arrays(template)
-    out_flat = {}
-    with np.load(path) as z:
-        for name, t in flat_t.items():
-            sharding = t.sharding
-            bufs = [
-                jax.device_put(z[f"{name}##{d.id}"], d)
-                for d in sharding.addressable_devices
-            ]
-            out_flat[name] = jax.make_array_from_single_device_arrays(
-                t.shape, sharding, bufs
-            )
-    return _unflatten(out_flat)
+    flat, idxmeta = actp.merge_npz_shards([path])
+    return actp.assemble_with_template(flat, idxmeta, template)
 
 
 # --- v2 tar checkpoint format, wire-compatible with the reference ---

@@ -24,6 +24,7 @@ from paddle_tpu.evaluators import create_evaluator
 from paddle_tpu.network import Network
 from paddle_tpu.optimizers import create_optimizer
 from paddle_tpu.parallel.dp import TrainStep
+from paddle_tpu.trainer import async_checkpoint as actp
 from paddle_tpu.trainer import checkpoint as ckpt
 from paddle_tpu.trainer.events import (
     BeginIteration,
@@ -166,82 +167,125 @@ class SGD:
         test_reader: Optional[Callable] = None,
         save_dir: Optional[str] = None,
         start_pass: int = 0,
+        checkpoint_mode: Optional[str] = None,
     ):
         """reader yields raw batches (lists of sample tuples); feeder
-        converts them to Arg dicts."""
+        converts them to Arg dicts.
+
+        checkpoint_mode: None = the `checkpoint_mode` flag; "sync" =
+        blocking per-pass save_pass; "async" = overlapped sharded
+        writes (trainer/async_checkpoint.py) where only the
+        device->host snapshot blocks the loop."""
         event_handler = event_handler or (lambda e: None)
         log_period = _flags.get_flag("log_period")
-        for pass_id in range(start_pass, num_passes):
-            event_handler(BeginPass(pass_id))
-            evals = self._make_evaluators()
-            costs = []
-            for batch_id, raw in enumerate(reader()):
-                event_handler(BeginIteration(pass_id, batch_id))
-                feed = feeder(raw)
-                rng = _rng.split_for_step(self.step_key, self.global_step)
-                with GLOBAL_STATS.timer("train_step"):
-                    (
-                        self.params,
-                        self.opt_state,
-                        self.state,
-                        loss,
-                        outs,
-                    ) = self.step_fn(
-                        self.params,
-                        self.opt_state,
-                        self.state,
-                        feed,
-                        self.global_step,
-                        rng,
+        ckpt_mode = checkpoint_mode or _flags.get_flag("checkpoint_mode")
+        if ckpt_mode not in ("sync", "async"):
+            raise ValueError(f"unknown checkpoint_mode {ckpt_mode!r}")
+        if save_dir and ckpt_mode == "async":
+            self._ensure_async_ckpt(save_dir)
+        ok = False
+        try:
+            for pass_id in range(start_pass, num_passes):
+                event_handler(BeginPass(pass_id))
+                evals = self._make_evaluators()
+                costs = []
+                for batch_id, raw in enumerate(reader()):
+                    event_handler(BeginIteration(pass_id, batch_id))
+                    feed = feeder(raw)
+                    rng = _rng.split_for_step(self.step_key, self.global_step)
+                    with GLOBAL_STATS.timer("train_step"):
+                        (
+                            self.params,
+                            self.opt_state,
+                            self.state,
+                            loss,
+                            outs,
+                        ) = self.step_fn(
+                            self.params,
+                            self.opt_state,
+                            self.state,
+                            feed,
+                            self.global_step,
+                            rng,
+                        )
+                    cost = float(loss)
+                    costs.append(cost)
+                    for ev in evals:
+                        ev.add_batch(outs, feed)
+                    self.global_step += 1
+                    results = (
+                        {ev.name: ev.result() for ev in evals}
+                        if (batch_id + 1) % log_period == 0
+                        else {}
                     )
-                cost = float(loss)
-                costs.append(cost)
-                for ev in evals:
-                    ev.add_batch(outs, feed)
-                self.global_step += 1
-                results = (
-                    {ev.name: ev.result() for ev in evals}
-                    if (batch_id + 1) % log_period == 0
-                    else {}
-                )
-                event_handler(
-                    EndIteration(pass_id, batch_id, cost, results)
-                )
-                if (batch_id + 1) % log_period == 0:
-                    log.info(
-                        "pass %d batch %d cost %.5f %s",
-                        pass_id,
-                        batch_id,
-                        float(np.mean(costs[-log_period:])),
-                        results,
+                    event_handler(
+                        EndIteration(pass_id, batch_id, cost, results)
                     )
-                stats_period = _flags.get_flag(
-                    "show_parameter_stats_period"
-                )
-                if stats_period and (batch_id + 1) % stats_period == 0:
-                    self._log_parameter_stats(pass_id, batch_id)
-            results = {ev.name: ev.result() for ev in evals}
-            if test_reader is not None:
-                tr = self.test(test_reader, feeder)
-                event_handler(
-                    TestResult(pass_id, tr["cost"], tr["evaluators"])
-                )
-            if save_dir:
-                ckpt.save_pass(
-                    save_dir,
-                    pass_id,
-                    jax.device_get(self.params),
-                    jax.device_get(self.opt_state),
-                    jax.device_get(self.state),
-                    meta={"global_step": self.global_step},
-                    save_only_one=_flags.get_flag("save_only_one"),
-                )
-            # per-pass timer report (the WITH_TIMER StatSet dump,
-            # TrainerInternal.cpp:177 area / utils/Stat.h:189) —
-            # reset after logging so each pass reports only itself
-            log.info("pass %d %s", pass_id, GLOBAL_STATS.report())
-            GLOBAL_STATS.reset()
-            event_handler(EndPass(pass_id, results))
+                    if (batch_id + 1) % log_period == 0:
+                        log.info(
+                            "pass %d batch %d cost %.5f %s",
+                            pass_id,
+                            batch_id,
+                            float(np.mean(costs[-log_period:])),
+                            results,
+                        )
+                    stats_period = _flags.get_flag(
+                        "show_parameter_stats_period"
+                    )
+                    if stats_period and (batch_id + 1) % stats_period == 0:
+                        self._log_parameter_stats(pass_id, batch_id)
+                results = {ev.name: ev.result() for ev in evals}
+                if test_reader is not None:
+                    tr = self.test(test_reader, feeder)
+                    event_handler(
+                        TestResult(pass_id, tr["cost"], tr["evaluators"])
+                    )
+                if save_dir:
+                    with GLOBAL_STATS.timer("checkpoint_save"):
+                        if ckpt_mode == "async":
+                            # every process commits its own shard; only the
+                            # host snapshot inside save() blocks the loop
+                            self._async_ckpt.save(
+                                pass_id,
+                                self.params,
+                                self.opt_state,
+                                self.state,
+                                meta={"global_step": self.global_step},
+                            )
+                        else:
+                            ckpt.save_pass(
+                                save_dir,
+                                pass_id,
+                                jax.device_get(self.params),
+                                jax.device_get(self.opt_state),
+                                jax.device_get(self.state),
+                                meta={"global_step": self.global_step},
+                                save_only_one=_flags.get_flag("save_only_one"),
+                            )
+                # per-pass timer report (the WITH_TIMER StatSet dump,
+                # TrainerInternal.cpp:177 area / utils/Stat.h:189) —
+                # reset after logging so each pass reports only itself
+                log.info("pass %d %s", pass_id, GLOBAL_STATS.report())
+                GLOBAL_STATS.reset()
+                event_handler(EndPass(pass_id, results))
+            ok = True
+        finally:
+            # drain in-flight async writes on EVERY exit path so a
+            # background failure surfaces here, with the training
+            # stack attached, not in a daemon thread; when already
+            # unwinding, a drain failure must not mask the
+            # training error
+            if save_dir and ckpt_mode == "async":
+                if ok:
+                    self._async_ckpt.wait()
+                else:
+                    try:
+                        self._async_ckpt.wait()
+                    except Exception:
+                        log.exception(
+                            "async checkpoint drain failed while "
+                            "handling a training error"
+                        )
 
     def test(self, reader: Callable, feeder: Callable) -> dict:
         """Evaluation pass (reference: trainer/Tester.h)."""
@@ -260,10 +304,47 @@ class SGD:
             "evaluators": {ev.name: ev.result() for ev in evals},
         }
 
+    def _ensure_async_ckpt(self, save_dir: str):
+        cur = getattr(self, "_async_ckpt", None)
+        if cur is not None and cur.save_dir == save_dir:
+            return cur
+        if cur is not None:
+            cur.close()
+        self._async_ckpt = actp.AsyncCheckpointer(
+            save_dir,
+            keep_last=1 if _flags.get_flag("save_only_one") else 0,
+        )
+        return self._async_ckpt
+
     def resume(self, save_dir: str, pass_id: int = -1) -> int:
         """Load a checkpoint; returns the next pass id (start_pass
-        semantics of trainer/ParamUtil.h)."""
-        params, opt_state, state, meta = ckpt.load_pass(save_dir, pass_id)
+        semantics of trainer/ParamUtil.h). Reads whichever format is
+        newest and COMPLETE: async sharded passes (manifest-verified,
+        torn shards skipped) or synchronous save_pass directories."""
+        if pass_id >= 0:
+            use_async = (
+                pass_id in actp.list_passes(save_dir)
+                and actp.verify_pass(save_dir, pass_id)[0]
+            )
+        else:
+            async_latest = actp.latest_complete_pass(save_dir)
+            sync_passes = ckpt.list_sync_passes(save_dir)
+            use_async = async_latest >= 0 and (
+                not sync_passes or async_latest >= sync_passes[-1]
+            )
+        if use_async:
+            # pass the already-resolved id: load_pass(-1) would re-hash
+            # every pass a second time to find the latest
+            tree, meta = actp.load_pass(
+                save_dir, pass_id if pass_id >= 0 else async_latest
+            )
+            params = tree["params"]
+            opt_state = tree.get("opt_state")
+            state = tree.get("state")
+        else:
+            params, opt_state, state, meta = ckpt.load_pass(
+                save_dir, pass_id
+            )
         self.params = {k: jax.numpy.asarray(v) for k, v in params.items()}
         if opt_state is not None:
             self.opt_state = jax.tree_util.tree_map(

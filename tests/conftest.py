@@ -14,12 +14,30 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent XLA compilation cache: the suite is compile-dominated on a
-# single-core gate machine, and repeated runs (judge re-runs, local
-# iteration) hit the cache and finish several times faster.
-XLA_CACHE_DIR = os.environ.get(
-    "PADDLE_TPU_TEST_CACHE", "/tmp/paddle_tpu_jax_cache"
-)
+# XLA compilation cache, scoped to THIS pytest session: subprocesses
+# spawned by tests (bench smokes, distributed workers, the elastic
+# trainer workers) share it through the exported env var, so the
+# expensive programs compile once per run.
+#
+# The dir is deliberately FRESH per session, not persistent:
+# deserializing cache entries from a previous session corrupts the
+# heap on this runtime ("corrupted double-linked list" / segfault
+# mid-dispatch, reproducibly killing the suite from test_v2_api
+# onward — the seed's 323-dots-then-abort). A cold run costs ~no extra
+# wall clock (the suite is dominated by unique in-process compiles),
+# and concurrent sessions (run_suite.sh shards) can no longer tear
+# each other's shared entries — the likely original poisoner.
+# PADDLE_TPU_TEST_CACHE overrides explicitly (at your own risk).
+XLA_CACHE_DIR = os.environ.get("PADDLE_TPU_TEST_CACHE")
+if not XLA_CACHE_DIR:
+    import atexit
+    import shutil
+    import tempfile
+
+    XLA_CACHE_DIR = tempfile.mkdtemp(prefix="paddle_tpu_jax_cache_")
+    # this (main) pytest process outlives every test subprocess that
+    # shares the dir, so cleaning at exit leaks nothing into /tmp
+    atexit.register(shutil.rmtree, XLA_CACHE_DIR, ignore_errors=True)
 jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
 # subprocess-spawning tests inherit the same cache through the
 # environment — plain assignment so it really is one source of truth

@@ -12,18 +12,28 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_multichip_bench_cpu_mesh_smoke():
+def _mc_env(tmp_path):
+    """Bench-subprocess env: single-device start (exercises the
+    re-exec onto the 8-device CPU mesh) and the full-row record
+    pointed at tmp so test runs never append to the committed
+    BENCH_full_rNN.jsonl artifact."""
+    env = {**os.environ}
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f
+    )
+    env["BENCH_FULL_RECORD"] = str(tmp_path / "bench_full.jsonl")
+    return env
+
+
+def test_multichip_bench_cpu_mesh_smoke(tmp_path):
     # one LSTM row via the PATTERN filter keeps the one-core CI cheap.
     # Strip any pre-set virtual-device-count from XLA_FLAGS so the
     # subprocess deterministically starts single-device and exercises
     # the re-exec onto the forced 8-device CPU mesh (on a box attached
     # to a real multi-chip slice the re-exec is skipped by design —
     # that path asserts the real-slice row shape instead).
-    env = {**os.environ}
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if "host_platform_device_count" not in f
-    )
+    env = _mc_env(tmp_path)
     r = subprocess.run(
         [sys.executable, "bench_multichip.py", "mc_lstm_h256_tbs256"],
         capture_output=True, text=True, cwd=REPO, timeout=420,
@@ -51,6 +61,42 @@ def test_multichip_bench_cpu_mesh_smoke():
         # genuine multi-chip hardware: the real-throughput row shape
         assert "synthetic" not in row
         assert row["vs_baseline"] > 0 and row["speedup"] > 0
+    # every emitted row also landed in the full-row artifact
+    # (ROADMAP 5b: non-north-star rows survive in a committed file)
+    full = [json.loads(ln)
+            for ln in open(env["BENCH_FULL_RECORD"]).read().splitlines()]
+    assert {ln["metric"] for ln in full} >= {
+        "mc_config", f"mc_lstm_h256_tbs256_dp{n}"}
+
+
+def test_checkpoint_overhead_row_async_beats_sync(tmp_path):
+    """The permanent elasticity row: checkpointing at a fixed cadence
+    must stall the training thread measurably less in async mode than
+    a synchronous save takes — otherwise the async subsystem is dead
+    weight (ISSUE 7 acceptance criterion)."""
+    env = _mc_env(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "bench_multichip.py", "checkpoint_overhead"],
+        capture_output=True, text=True, cwd=REPO, timeout=420,
+        env=env,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    by_name = {ln["metric"]: ln for ln in lines}
+    n = by_name["mc_config"]["devices"]
+    row = by_name[f"mc_checkpoint_overhead_dp{n}"]
+    assert row.get("error") is None, row
+    # the checkpoint is big enough that a sync save visibly stalls
+    assert row["checkpoint_mb"] > 5
+    assert row["sync_save_ms"] > 0
+    # the async contract: per-save training-thread stall is well below
+    # the synchronous save time (generous 2x margin for CI noise; the
+    # measured ratio on the CPU mesh is ~0.02)
+    assert row["async_stall_ms"] < row["sync_save_ms"] * 0.5, row
+    # and the async writer really committed manifest-complete passes
+    # (keep_last=2 rotation: exactly the newest 2 survive the run)
+    assert row["async_committed_passes"] == 2, row
 
 
 def test_multichip_rows_cover_reference_matrix():

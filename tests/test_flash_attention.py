@@ -1,8 +1,13 @@
 """Flash attention (Pallas TPU kernel) vs the dense reference path:
 same contract (causal + kv_len padding via segment ids), forward and
 gradients within bf16-kernel tolerance. TPU-only — the Pallas kernel
-has no CPU lowering; the CPU suite covers the dense path everywhere
-and the longctx bench row A/Bs the two on hardware."""
+has no CPU lowering; the CPU suite covers the dense path everywhere.
+
+Coverage note (ROADMAP item 1): this parity test is currently the ONLY
+check the flash kernel gets. The longctx bench rows
+(bench.bench_longctx) still build plain dense attention and do NOT A/B
+flash vs dense; no bench row exercises the flash kernel until the
+`attn_impl="flash"` wiring lands."""
 
 import jax
 import jax.numpy as jnp

@@ -173,6 +173,58 @@ class TestImage:
         chw = pimg.load_and_transform(p, 32, 24, is_train=True)
         assert chw.shape == (3, 24, 24)
 
+    def test_batch_images_from_tar(self, tmp_path):
+        """reference image.py batch_images_from_tar: tar members named
+        in img2label are pickled into batch files of num_per_batch,
+        with a meta file listing every batch; unlabeled members are
+        skipped; an existing batch dir short-circuits."""
+        import io
+        import pickle
+        import tarfile
+
+        from PIL import Image
+
+        tar_path = str(tmp_path / "imgs.tar")
+        img2label = {}
+        with tarfile.open(tar_path, "w") as tar:
+            for i in range(5):
+                buf = io.BytesIO()
+                Image.fromarray(self._im(8, 8)).save(buf, format="PNG")
+                raw = buf.getvalue()
+                info = tarfile.TarInfo(name=f"img_{i}.png")
+                info.size = len(raw)
+                tar.addfile(info, io.BytesIO(raw))
+                if i != 3:  # img_3 has no label -> must be skipped
+                    img2label[f"img_{i}.png"] = i % 2
+            info = tarfile.TarInfo(name="README")  # non-image member
+            info.size = 2
+            tar.addfile(info, io.BytesIO(b"hi"))
+
+        meta = pimg.batch_images_from_tar(
+            tar_path, "train", img2label, num_per_batch=3
+        )
+        batch_files = open(meta).read().splitlines()
+        assert len(batch_files) == 2  # 4 labeled images / 3 per batch
+
+        labels, blobs = [], []
+        for bf in batch_files:
+            with open(bf, "rb") as f:
+                d = pickle.load(f)
+            assert len(d["label"]) == len(d["data"]) <= 3
+            labels += d["label"]
+            blobs += d["data"]
+        assert sorted(labels) == [0, 0, 0, 1]  # i%2 for i in 0,1,2,4
+        # payloads are the raw image bytes, decodable as images
+        im = pimg.load_image_bytes(blobs[0])
+        assert im.shape == (8, 8, 3)
+
+        # second call reuses the existing batch dir (resume behavior)
+        meta2 = pimg.batch_images_from_tar(
+            tar_path, "train", {"img_0.png": 0}, num_per_batch=3
+        )
+        assert meta2 == meta
+        assert open(meta).read().splitlines() == batch_files
+
 
 def test_sparse_sequence_feeding():
     """sparse_binary/float_vector SEQUENCE slots
