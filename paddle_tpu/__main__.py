@@ -4,8 +4,9 @@ Reference: paddle/scripts/submit_local.sh.in:3-13 — subcommands
 train / pserver / merge_model / dump_config / make_diagram / version —
 plus trainer/TrainerMain.cpp and trainer/MergeModel.cpp. TPU-native
 differences: there is no pserver process (data parallelism is one pjit
-program; `master` serves the elastic-input role instead), and `bench`
-wraps the repo benchmark harness.
+program; `master` serves the elastic-input role instead), `bench`
+wraps the repo benchmark harness, and `serve` runs the
+continuous-batching inference server (paddle_tpu/serving).
 
 A config file is a Python source that defines:
     get_config() -> (ModelConf, OptimizationConf)
@@ -350,6 +351,47 @@ def cmd_master(args):
     return 0
 
 
+def cmd_serve(args):
+    """Run the continuous-batching inference server (serving/). The
+    config file defines `get_server() -> serving.InferenceServer` with
+    its models already registered; this command owns the TCP front end
+    and the drain-on-shutdown lifecycle (SIGTERM/SIGINT -> stop
+    admission, finish or cleanly reject in-flight work, exit 0)."""
+    import json as _json
+    import signal
+    import time as _time
+
+    from paddle_tpu.serving.tcp import ServingTCPServer
+
+    spec = importlib.util.spec_from_file_location("_serve_config",
+                                                  args.config)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if not hasattr(mod, "get_server"):
+        raise SystemExit(
+            f"{args.config} must define get_server() -> InferenceServer"
+        )
+    server = mod.get_server()
+    tcp = ServingTCPServer(server, port=args.port)
+    print(f"LISTENING {tcp.port}", flush=True)
+
+    stopping = []
+    signal.signal(signal.SIGTERM, lambda *_: stopping.append(1))
+    signal.signal(signal.SIGINT, lambda *_: stopping.append(1))
+    try:
+        while not stopping:
+            _time.sleep(0.1)
+    finally:
+        # stop NEW connections first, drain with established clients
+        # still attached (their in-flight responses must land), then
+        # close what remains
+        tcp.stop_accepting()
+        server.shutdown(drain=True, timeout=args.drain_timeout)
+        tcp.stop()
+        print("DRAINED " + _json.dumps(server.stats()), flush=True)
+    return 0
+
+
 def cmd_make_diagram(args):
     """Emit a graphviz .dot of the layer graph (the reference's
     `paddle make_diagram`, scripts/submit_local.sh.in:3-13)."""
@@ -438,6 +480,19 @@ def main(argv=None):
     sp.add_argument("--failure_max", type=int, default=3)
     sp.add_argument("--snapshot", default="")
     sp.set_defaults(fn=cmd_master)
+
+    sp = sub.add_parser(
+        "serve",
+        help="run the continuous-batching inference server "
+             "(bounded queue, load shedding, deadlines, drain)",
+    )
+    sp.add_argument("--config", required=True,
+                    help="python file defining get_server()")
+    sp.add_argument("--port", type=int, default=0,
+                    help="TCP port (0 = ephemeral, printed as "
+                         "LISTENING <port>)")
+    sp.add_argument("--drain_timeout", type=float, default=30.0)
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("make_diagram", help="emit graphviz dot of a config")
     sp.add_argument("--config", required=True)

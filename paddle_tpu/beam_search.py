@@ -144,13 +144,15 @@ class BeamSearchDecoder:
         """Parameter table of the step net (names shared with training)."""
         return self._build(statics).param_confs
 
-    def generate(self, params: dict, statics: list, boots: dict = None,
-                 batch_size: int = None):
-        """statics: list[Arg] (batch-major, B rows). boots: memory layer
-        name -> [B, size] boot value (overrides zeros/boot_value).
-        Returns (seqs [B, K, max_length] int32, lens [B, K], scores [B, K]),
-        beams sorted best-first."""
-        net = self._net or self._build(statics)
+    def prepare(self, statics: list, boots: dict = None,
+                batch_size: int = None):
+        """Build (static_feed, init_carry_mem, b) — the K-tiled feed
+        dict and boot memories both decode paths start from. Shared by
+        the jitted while-loop program (generate) and the host-stepped
+        per-token path (serving/host_decode.py), so the two rungs of
+        the serving degradation ladder see identical inputs."""
+        if self._net is None:
+            self._build(statics)
         k = self.k
         boots = boots or {}
         if batch_size is not None:
@@ -190,7 +192,17 @@ class BeamSearchDecoder:
                 init_carry_mem[m["layer"]] = jnp.full(
                     (b * k, m["size"]), m.get("boot_value", 0.0), jnp.float32
                 )
+        return static_feed, init_carry_mem, b
 
+    def generate(self, params: dict, statics: list, boots: dict = None,
+                 batch_size: int = None):
+        """statics: list[Arg] (batch-major, B rows). boots: memory layer
+        name -> [B, size] boot value (overrides zeros/boot_value).
+        Returns (seqs [B, K, max_length] int32, lens [B, K], scores [B, K]),
+        beams sorted best-first."""
+        static_feed, init_carry_mem, b = self.prepare(
+            statics, boots, batch_size
+        )
         run = self._decode_program()
         seqs, lens, scores = run(params, static_feed, init_carry_mem, b)
         return seqs, lens, scores

@@ -47,26 +47,62 @@ def _register_arg_serialization():
     _ARG_SERIALIZATION_REGISTERED = True
 
 
+# export envelope: magic + sha256(payload) + payload. The digest lets
+# load_compiled reject a torn or bit-flipped artifact with a clear
+# ValueError BEFORE the bytes reach XLA's deserializer (whose failure
+# mode on corrupt input ranges from cryptic to process-fatal).
+_EXPORT_MAGIC = b"PTPUXP1\x00"
+
+
 def export_compiled(inferencer: Inferencer, example_feed: dict) -> bytes:
     """Serialize the jitted forward specialized to `example_feed`'s
-    shapes/dtypes as a StableHLO artifact (bytes)."""
+    shapes/dtypes as a checksummed StableHLO artifact (bytes)."""
+    import hashlib
+
     from jax import export as jexport
 
     _register_arg_serialization()
     exp = jexport.export(inferencer._fwd)(
         inferencer.params, inferencer.state, example_feed
     )
-    return exp.serialize()
+    payload = exp.serialize()
+    return _EXPORT_MAGIC + hashlib.sha256(payload).digest() + payload
 
 
-def load_compiled(blob: bytes):
+def load_compiled(blob: bytes, source: str = "<compiled blob>"):
     """Rehydrate an export_compiled artifact; returns
     fn(params, state, feed) -> {name: Arg}. Runs without the
-    model-building code (config/layers) present."""
+    model-building code (config/layers) present. `source` names the
+    artifact (e.g. its path) in error messages. A truncated or
+    corrupted blob raises ValueError naming the artifact instead of
+    crashing inside XLA."""
+    import hashlib
+
     from jax import export as jexport
 
     _register_arg_serialization()
-    return jexport.deserialize(blob).call
+    blob = bytes(blob)
+    if blob.startswith(_EXPORT_MAGIC):
+        head = len(_EXPORT_MAGIC)
+        digest, payload = blob[head:head + 32], blob[head + 32:]
+        if len(digest) < 32 or hashlib.sha256(payload).digest() != digest:
+            kind = "truncated" if len(blob) < head + 33 else "corrupt"
+            raise ValueError(
+                f"compiled StableHLO artifact {source!r} is {kind}: "
+                f"checksum mismatch over {len(payload)} payload bytes "
+                f"— re-run export_compiled"
+            )
+    else:
+        payload = blob  # pre-envelope artifact: best-effort load
+    try:
+        exp = jexport.deserialize(payload)
+    except Exception as e:
+        raise ValueError(
+            f"compiled StableHLO artifact {source!r} failed to "
+            f"deserialize (truncated/corrupt or version-skewed): "
+            f"{type(e).__name__}: {e}"
+        ) from e
+    return exp.call
 
 
 def infer(output=None, parameters=None, input=None, network=None,

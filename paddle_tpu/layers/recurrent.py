@@ -53,13 +53,40 @@ def _use_fused(bsz=None, t_max=None, h=None, mult=4) -> bool:
     The shape parameters are intentionally retained (unused) so call
     sites keep passing them — if a future XLA/Mosaic shift flips the
     A/B (the bench row watches it), the shape-dependent policy slots
-    back in without touching callers."""
+    back in without touching callers.
+
+    Round-6 verdict (ROADMAP 5a, PERF.md "fused-RNN family retired"):
+    the family is formally RETIRED as a production path. The GRU
+    backward was never landed (it recomputes through the scan
+    reference, so fused-GRU training pays kernel forward + scan
+    backward), and the completed LSTM pair loses to the scan at every
+    measured shape — engaging the flag now warns DeprecationWarning
+    once per process. The kernels stay in-tree, correctness-tested, as
+    the hl_cuda_lstm.cu capability match and the A/B tripwire arm."""
     from paddle_tpu.core.flags import get_flag
 
     v = get_flag("use_pallas_rnn")
     if v is not None:
+        if bool(v) and not _WARNED_FUSED_OPTIN:
+            import warnings
+
+            _WARNED_FUSED_OPTIN.append(True)
+            warnings.warn(
+                "use_pallas_rnn=True engages the RETIRED fused Pallas "
+                "RNN path: measured slower than XLA lax.scan at every "
+                "tested shape (PERF.md), and GRU has no fused backward "
+                "(training recomputes through the scan). Kept for "
+                "kernel A/B testing only.",
+                DeprecationWarning,
+                stacklevel=3,
+            )
         return bool(v)
     return False
+
+
+# once-per-process latch: the bench A/B flips the flag per timing
+# window and must not spam a warning per engaged forward
+_WARNED_FUSED_OPTIN: list = []
 
 
 def _interpret_mode() -> bool:

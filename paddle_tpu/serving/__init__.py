@@ -1,0 +1,23 @@
+"""Continuous-batching inference serving (ROADMAP item 3).
+
+The pieces:
+- `server` — bounded admission queue, load shedding, deadline-aware
+  batch formation, bucketed continuous packing, per-model circuit
+  breaker, drain-on-shutdown.
+- `models` — GenerationModel (beam decode + host-stepped hook
+  fallback) and MultiForwardHost (merged multi-model forward serving).
+- `host_decode` — the per-token host-stepped decode rung (hooks
+  without pure_callback).
+- `tcp` — length-prefixed-JSON TCP front end + client.
+
+CLI: `python -m paddle_tpu serve --config serve_conf.py [--port N]`
+where the config defines `get_server() -> InferenceServer`.
+"""
+
+from paddle_tpu.serving.server import (  # noqa: F401
+    InferenceServer,
+    PendingResult,
+    ServeConfig,
+    ServeError,
+    ServeRejected,
+)

@@ -1,0 +1,198 @@
+"""Served-model wrappers: what the scheduler dispatches to.
+
+Two engine kinds compose the existing pieces:
+
+- `GenerationModel` — beam-search generation over a
+  `BeamSearchDecoder`. Rung 1 is the decoder's own jitted while-loop
+  program (bounded decode-program cache, `beam_search.py`); rung 2 is
+  the host-stepped per-token path (`host_decode.py`), taken whenever
+  generation hooks are present (pure_callback-free, so hook-bearing
+  requests stay servable on runtimes that reject host callbacks) or
+  when rung 1 fails and the server's `host_fallback` is on. An
+  optional `encode` callable turns the packed source ids into the
+  decoder's statics/boots (the seq2seq encoder forward).
+
+- `MultiForwardHost` — N forward-scoring submodels merged into ONE
+  compiled program via `multi_network.merge_confs`, each submodel's
+  requests packed with the bucketed `DataFeeder` and routed by
+  `prefix_feed` names. The scheduler co-dispatches sibling models'
+  pending batches through `run_group`, so one program launch serves
+  several models' traffic — MultiNetwork's joint execution, serving-
+  shaped.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+class GenerationModel:
+    """`decoder`: a BeamSearchDecoder (or models.text factory output).
+    `encode(ids [B,T] i32, lens [B] i32) -> (statics list[Arg], boots
+    dict)` builds the decoder conditioning; None means an
+    unconditioned decoder (statics=[], batch_size=B). `named_hooks`
+    maps wire-addressable hook names to BeamHooks — the TCP front end
+    cannot ship callables, so hook-bearing requests name a hook the
+    model registered at build time."""
+
+    can_host = True
+    engine = None
+
+    def __init__(self, decoder, params, encode: Optional[Callable] = None,
+                 named_hooks: Optional[Dict] = None):
+        self.decoder = decoder
+        self.params = params
+        self.encode = encode
+        self.named_hooks = named_hooks or {}
+
+    def run_batch(self, ids, lens, hooks, host: bool):
+        from paddle_tpu.serving.host_decode import host_generate
+
+        dec = self.decoder
+        b = ids.shape[0]
+        if self.encode is not None:
+            statics, boots = self.encode(ids, lens)
+            bs = None
+        else:
+            statics, boots, bs = [], None, b
+        if host or hooks is not None:
+            seqs, out_lens, scores = host_generate(
+                dec, self.params, statics=statics, boots=boots,
+                batch_size=bs, hooks=hooks,
+            )
+            path = "host"
+        else:
+            seqs, out_lens, scores = dec.generate(
+                self.params, statics=statics, boots=boots, batch_size=bs
+            )
+            path = "jit"
+        seqs = np.asarray(seqs)
+        out_lens = np.asarray(out_lens)
+        scores = np.asarray(scores, np.float32)
+        rows = []
+        for i in range(b):
+            n = int(out_lens[i, 0])
+            rows.append({
+                "tokens": seqs[i, 0, :n].tolist(),
+                "score": float(scores[i, 0]),
+                "path": path,
+            })
+        return rows
+
+
+class _ForwardSub:
+    """One submodel's face toward the server: run_batch packs this
+    submodel alone; the scheduler upgrades to run_group when siblings
+    have pending work."""
+
+    can_host = False
+
+    def __init__(self, host: "MultiForwardHost", name: str):
+        self.engine = host
+        self.name = name
+        self.named_hooks = {}
+
+    def run_batch(self, ids, lens, hooks, host: bool):
+        out = self.engine.run_group({self.name: (ids, lens)})
+        return out[self.name]
+
+
+class MultiForwardHost:
+    """confs: {name: ModelConf}; every submodel is a single-ids-input
+    scorer (data layer `input_name`, output layer `output_name`).
+    Parameters with explicit shared names alias across submodels
+    exactly as MultiNetwork shared them. `init_params` (or a trained
+    merged dict) provides the weights for the MERGED conf."""
+
+    def __init__(self, confs: Dict[str, object], params=None,
+                 input_names: Dict[str, str] = None,
+                 output_names: Dict[str, str] = None, seed: int = 0):
+        import jax
+
+        from paddle_tpu.multi_network import merge_confs
+        from paddle_tpu.network import Network
+
+        self.confs = dict(confs)
+        self.names = tuple(self.confs)
+        self.input_names = input_names or {}
+        self.output_names = output_names or {}
+        self.merged = merge_confs(self.confs)
+        self.net = Network(self.merged)
+        self.params = (
+            params if params is not None
+            else self.net.init_params(jax.random.key(seed))
+        )
+        self._fwd_cache = {}
+
+    def sub(self, name: str) -> _ForwardSub:
+        assert name in self.confs, name
+        return _ForwardSub(self, name)
+
+    def _jit_fwd(self, want: tuple):
+        """One jitted merged forward per output set (in practice one:
+        every data layer is always fed) — a single compiled program
+        launch per dispatch, with jax.jit handling shape-keyed
+        retraces inside the entry."""
+        fn = self._fwd_cache.get(want)
+        if fn is None:
+            import jax
+
+            def run(params, feed):
+                outs, _ = self.net.forward(params, feed,
+                                           outputs=list(want),
+                                           train=False)
+                return {w: outs[w].value for w in want}
+
+            fn = self._fwd_cache[want] = jax.jit(run)
+        return fn
+
+    def _io(self, name):
+        conf = self.confs[name]
+        inp = self.input_names.get(name) or next(
+            lc.name for lc in conf.layers if lc.type == "data"
+        )
+        out = self.output_names.get(name) or (
+            conf.output_layer_names[-1] if conf.output_layer_names
+            else conf.layers[-1].name
+        )
+        return inp, out
+
+    def run_group(self, packed: Dict[str, tuple]) -> Dict[str, list]:
+        """packed: {name: (ids [B,T] i32, lens [B] i32)} for the models
+        with pending work. Absent submodels get a 1-row zero feed (the
+        merged program needs every data layer); their outputs are
+        discarded. One program launch serves every present model."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.arg import Arg
+        from paddle_tpu.multi_network import prefix_feed
+
+        feed = {}
+        want = []
+        for name in self.names:
+            inp, out = self._io(name)
+            if name in packed:
+                ids, lens = packed[name]
+                sub_feed = {inp: Arg(
+                    ids=jnp.asarray(ids, jnp.int32),
+                    seq_lens=jnp.asarray(lens, jnp.int32),
+                )}
+            else:
+                sub_feed = {inp: Arg(
+                    ids=jnp.zeros((1, 1), jnp.int32),
+                    seq_lens=jnp.ones((1,), jnp.int32),
+                )}
+            feed.update(prefix_feed(name, sub_feed))
+            want.append(f"{name}/{out}")
+        outs = self._jit_fwd(tuple(want))(self.params, feed)
+        results = {}
+        for name in packed:
+            _, out = self._io(name)
+            val = np.asarray(outs[f"{name}/{out}"])
+            results[name] = [
+                {"scores": val[i].ravel().tolist(), "path": "jit"}
+                for i in range(val.shape[0])
+            ]
+        return results

@@ -1,0 +1,527 @@
+"""Continuous-batching inference server with overload protection.
+
+The composition ROADMAP item 3 names: requests arrive continuously and
+variable-length, are admitted into a BOUNDED queue (full queue =
+explicit rejection, never unbounded growth), and a scheduler thread
+packs compatible requests — same model, same length bucket, same hook
+configuration — into batches dispatched to the cached bucketed decode
+programs. SLO machinery, in dispatch order:
+
+- **Load shedding at admission.** `submit` rejects with
+  `ServeRejected("overloaded")` the instant the queue is full. Orca's
+  and vLLM's admission story: overload shows up as fast explicit
+  failures the client can retry elsewhere, not as latency collapse.
+- **Deadline-aware batch formation.** Every request carries a
+  deadline. At batch-formation time the scheduler drops requests whose
+  deadline has passed OR whose remaining budget is smaller than the
+  model's EWMA batch service time — expired work is rejected BEFORE it
+  wastes a decode program, not after.
+- **Bucketed continuous packing.** Sequence lengths round up to the
+  feeder's buckets and batch sizes round up to power-of-two batch
+  buckets, so the jit program cache stays bounded at
+  O(len_buckets × batch_buckets) per model instead of one program per
+  arrival shape.
+- **Degradation ladder.** Rung 1: the jitted while-loop decode. Rung 2
+  (hooks present, or rung 1 raised and `host_fallback`): host-stepped
+  per-token decode (`host_decode.py`) — generation hooks run as plain
+  Python, closing the "hook-bearing request gets no TPU path" hole.
+  Rung 3: explicit failure.
+- **Circuit breaker per model.** `breaker_threshold` consecutive
+  dispatch failures quarantine the model: submits reject instantly
+  with `ServeRejected("quarantined")` for `breaker_reset_s`, then one
+  half-open probe batch decides re-close vs re-open — a model whose
+  decode program is poisoned cannot eat the whole queue.
+- **Drain on shutdown.** `shutdown(drain=True)` stops admission
+  (rejects with "shutting_down"), lets the scheduler finish or
+  deadline-reject everything queued, and joins the workers. Every
+  request ever admitted reaches a terminal state — nothing leaks.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Optional
+
+from paddle_tpu.data.feeder import _bucket
+
+
+class ServeRejected(Exception):
+    """Explicit request rejection. `reason` is one of: overloaded,
+    deadline, quarantined, shutting_down, unknown_model,
+    unknown_hook."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}{': ' + detail if detail else ''}")
+        self.reason = reason
+
+
+class ServeError(Exception):
+    """The request was dispatched but execution failed on every rung."""
+
+
+@dataclass
+class ServeConfig:
+    max_queue: int = 64           # admission bound (requests)
+    max_batch: int = 8            # per-dispatch batch cap
+    default_deadline_s: float = 2.0
+    buckets: tuple = (8, 16, 32, 64, 128)  # sequence-length buckets
+    breaker_threshold: int = 3    # consecutive failures -> quarantine
+    breaker_reset_s: float = 5.0  # quarantine window before half-open
+    host_fallback: bool = True    # rung-2 on jitted dispatch failure
+    workers: int = 1              # scheduler/dispatch threads
+    # margin multiplier on the EWMA service time used by the
+    # deadline-aware batch former (drop if remaining < ewma * margin)
+    service_margin: float = 1.0
+
+    def batch_bucket(self, n: int) -> int:
+        b = 1
+        while b < n and b < self.max_batch:
+            b *= 2
+        return min(b, self.max_batch)
+
+
+_ids = itertools.count(1)
+
+
+class PendingResult:
+    """Handle returned by submit(): blocks in result(), or poll state.
+    Terminal states: done / rejected / error."""
+
+    __slots__ = ("id", "model", "ids", "bucket", "deadline", "hooks",
+                 "hooks_key", "t_submit", "t_done", "_event", "_result",
+                 "_exc")
+
+    def __init__(self, model, ids, bucket, deadline, hooks, hooks_key):
+        self.id = next(_ids)
+        self.model = model
+        self.ids = ids
+        self.bucket = bucket
+        self.deadline = deadline
+        self.hooks = hooks
+        self.hooks_key = hooks_key
+        self.t_submit = time.monotonic()
+        self.t_done = None
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    # -- completion (server side) --
+    def _finish(self, result=None, exc=None):
+        self._result, self._exc = result, exc
+        self.t_done = time.monotonic()
+        self._event.set()
+
+    # -- consumption (client side) --
+    @property
+    def state(self) -> str:
+        if not self._event.is_set():
+            return "pending"
+        if self._exc is None:
+            return "done"
+        if isinstance(self._exc, ServeRejected):
+            return f"rejected:{self._exc.reason}"
+        return "error"
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+    def result(self, timeout: float = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} still pending")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Breaker:
+    """Per-model circuit breaker: closed -> open after N consecutive
+    failures -> half-open probe after reset_s -> closed on success."""
+
+    def __init__(self, threshold: int, reset_s: float):
+        self.threshold = threshold
+        self.reset_s = reset_s
+        self.failures = 0
+        self.opened_at = None
+        self.probing = False
+
+    @property
+    def state(self) -> str:
+        if self.opened_at is None:
+            return "closed"
+        if time.monotonic() - self.opened_at >= self.reset_s:
+            return "half-open"
+        return "open"
+
+    def admits(self) -> bool:
+        return self.state != "open"
+
+    def try_probe(self) -> bool:
+        """In half-open, exactly one in-flight probe batch at a time."""
+        if self.state == "closed":
+            return True
+        if self.state == "half-open" and not self.probing:
+            self.probing = True
+            return True
+        return False
+
+    def record(self, ok: bool):
+        self.probing = False
+        if ok:
+            self.failures = 0
+            self.opened_at = None
+        else:
+            self.failures += 1
+            if self.failures >= self.threshold:
+                self.opened_at = time.monotonic()
+
+
+@dataclass
+class _ModelEntry:
+    model: object
+    breaker: _Breaker
+    ewma_batch_s: float = 0.0     # EWMA dispatch service time
+    dispatch_keys: set = field(default_factory=set)
+
+
+class InferenceServer:
+    """Register models with add_model(), feed it with submit(), stop it
+    with shutdown(). Thread-safe; owns `config.workers` scheduler
+    threads. A model is any object with
+
+        run_batch(ids [B, T_bucket] int32, lens [B] int32,
+                  hooks, host: bool) -> list of per-row result dicts
+
+    plus optional `named_hooks` (str -> BeamHooks, the TCP-addressable
+    hook registry) and optional `engine` (a co-dispatch group — see
+    models.MultiForwardHost)."""
+
+    def __init__(self, config: ServeConfig = None):
+        self.config = config or ServeConfig()
+        self._models: dict = {}
+        self._queue: deque = deque()
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._draining = False
+        self._stopped = False
+        self._stats = {
+            "admitted": 0, "completed": 0, "completed_host": 0,
+            "shed_overload": 0, "shed_deadline": 0, "shed_quarantined": 0,
+            "shed_shutdown": 0, "failed": 0, "batches": 0,
+            "batches_codispatch": 0, "max_queue_depth": 0,
+        }
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"serve-{i}",
+                             daemon=True)
+            for i in range(self.config.workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # ------------------------------------------------------------ API
+    def add_model(self, name: str, model) -> None:
+        with self._lock:
+            self._models[name] = _ModelEntry(
+                model=model,
+                breaker=_Breaker(self.config.breaker_threshold,
+                                 self.config.breaker_reset_s),
+            )
+
+    def submit(self, model: str, ids, deadline_s: float = None,
+               hooks=None, hooks_name: str = None) -> PendingResult:
+        """Admit one request (ids: 1-D int sequence). Raises
+        ServeRejected instead of queueing when the server cannot meet
+        it — the explicit-shed contract."""
+        import numpy as np
+
+        cfg = self.config
+        with self._lock:
+            if self._draining or self._stopped:
+                self._stats["shed_shutdown"] += 1
+                raise ServeRejected("shutting_down")
+            entry = self._models.get(model)
+            if entry is None:
+                raise ServeRejected("unknown_model", model)
+            if hooks_name is not None:
+                named = getattr(entry.model, "named_hooks", None) or {}
+                hooks = named.get(hooks_name)
+                if hooks is None:
+                    raise ServeRejected(
+                        "unknown_hook",
+                        f"model {model!r} has no hook {hooks_name!r}",
+                    )
+            if not entry.breaker.admits():
+                self._stats["shed_quarantined"] += 1
+                raise ServeRejected("quarantined", model)
+            if len(self._queue) >= cfg.max_queue:
+                self._stats["shed_overload"] += 1
+                raise ServeRejected(
+                    "overloaded", f"queue at bound {cfg.max_queue}"
+                )
+            ids = np.asarray(ids, np.int32).reshape(-1)
+            bucket = _bucket(max(len(ids), 1), cfg.buckets)
+            deadline = time.monotonic() + (
+                deadline_s if deadline_s is not None
+                else cfg.default_deadline_s
+            )
+            hooks_key = (hooks_name or id(hooks)) if hooks is not None \
+                else None
+            req = PendingResult(model, ids, bucket, deadline, hooks,
+                                hooks_key)
+            self._queue.append(req)
+            self._stats["admitted"] += 1
+            self._stats["max_queue_depth"] = max(
+                self._stats["max_queue_depth"], len(self._queue)
+            )
+            self._work.notify()
+            return req
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["queue_depth"] = len(self._queue)
+            out["models"] = {
+                n: {"breaker": e.breaker.state,
+                    "ewma_batch_ms": round(e.ewma_batch_s * 1e3, 2),
+                    "dispatch_keys": len(e.dispatch_keys)}
+                for n, e in self._models.items()
+            }
+            return out
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop admission; with drain=True finish (or deadline-reject)
+        queued work, else reject everything queued. Idempotent."""
+        with self._lock:
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    self._reject_locked(self._queue.popleft(),
+                                        "shutting_down")
+            self._work.notify_all()
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        with self._lock:
+            self._stopped = True
+            # belt-and-braces: anything a worker left behind (join
+            # timeout) is rejected, never silently dropped
+            while self._queue:
+                self._reject_locked(self._queue.popleft(), "shutting_down")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=True)
+
+    # ------------------------------------------------------ scheduler
+    def _reject_locked(self, req: PendingResult, reason: str):
+        stat = "shed_shutdown" if reason == "shutting_down" \
+            else f"shed_{reason}"
+        self._stats[stat] = self._stats.get(stat, 0) + 1
+        req._finish(exc=ServeRejected(reason))
+
+    def _pop_batch_locked(self):
+        """Form one dispatchable batch: FIFO head picks the key
+        (model, bucket, hooks); compatible requests join up to
+        max_batch. Deadline-expired or budget-short requests are
+        rejected here — before dispatch. Returns (entry, key, reqs) or
+        None. Skips (leaves queued) requests whose model breaker is
+        open-with-probe-in-flight."""
+        now = time.monotonic()
+        cfg = self.config
+        skipped = []
+        head = None
+        while self._queue:
+            r = self._queue.popleft()
+            entry = self._models.get(r.model)
+            if entry is None:
+                r._finish(exc=ServeRejected("unknown_model", r.model))
+                continue
+            margin = entry.ewma_batch_s * cfg.service_margin
+            if now > r.deadline or now + margin > r.deadline:
+                self._reject_locked(r, "deadline")
+                continue
+            if not entry.breaker.try_probe():
+                if entry.breaker.state == "open":
+                    self._reject_locked(r, "quarantined")
+                else:
+                    skipped.append(r)  # half-open, probe in flight
+                continue
+            head = (entry, r)
+            break
+        for r in reversed(skipped):
+            self._queue.appendleft(r)
+        if head is None:
+            return None
+        entry, first = head
+        key = (first.model, first.bucket, first.hooks_key)
+        batch = [first]
+        if entry.breaker.state == "closed":
+            rest = []
+            while self._queue and len(batch) < cfg.max_batch:
+                r = self._queue.popleft()
+                if (r.model, r.bucket, r.hooks_key) == key:
+                    margin = entry.ewma_batch_s * cfg.service_margin
+                    if now + margin > r.deadline:
+                        self._reject_locked(r, "deadline")
+                    else:
+                        batch.append(r)
+                else:
+                    rest.append(r)
+            for r in reversed(rest):
+                self._queue.appendleft(r)
+        return entry, key, batch
+
+    def _pop_sibling_batches_locked(self, engine, exclude_model: str):
+        """Co-dispatch: when the head batch belongs to a multi-model
+        engine, opportunistically pull one hook-free batch for each
+        sibling model so a single merged program serves several models'
+        traffic (the `multi_network` batching-across-models story)."""
+        extra = {}
+        for name in getattr(engine, "names", ()):
+            if name == exclude_model:
+                continue
+            entry = self._models.get(name)
+            # only fully-healthy siblings join a co-dispatch: half-open
+            # probes stay on the head path where they are capped at one
+            # request and individually accounted
+            if entry is None or entry.breaker.state != "closed":
+                continue
+            picked, rest, key = [], [], None
+            now = time.monotonic()
+            margin = entry.ewma_batch_s * self.config.service_margin
+            while self._queue and len(picked) < self.config.max_batch:
+                r = self._queue.popleft()
+                if r.model != name or r.hooks_key is not None:
+                    rest.append(r)
+                    continue
+                if now + margin > r.deadline:
+                    # same budget rule as the head path: expired or
+                    # budget-short work never reaches the program
+                    self._reject_locked(r, "deadline")
+                    continue
+                if key is None:
+                    key = r.bucket
+                if r.bucket == key:
+                    picked.append(r)
+                else:
+                    rest.append(r)
+            for r in reversed(rest):
+                self._queue.appendleft(r)
+            if picked:
+                extra[name] = (entry, picked)
+        return extra
+
+    def _worker(self):
+        while True:
+            with self._work:
+                while not self._queue and not self._draining:
+                    self._work.wait(timeout=0.1)
+                if not self._queue and self._draining:
+                    return
+                popped = self._pop_batch_locked()
+                if popped is None:
+                    if self._queue:
+                        # everything queued is parked behind a
+                        # half-open probe: yield, don't hot-spin
+                        self._work.wait(timeout=0.01)
+                    continue
+                entry, key, batch = popped
+                engine = getattr(entry.model, "engine", None)
+                extra = {}
+                if engine is not None and key[2] is None:
+                    extra = self._pop_sibling_batches_locked(
+                        engine, key[0]
+                    )
+            self._dispatch(entry, key, batch, engine, extra)
+
+    # ------------------------------------------------------- dispatch
+    def _pack(self, batch, bucket):
+        """[B_bucket, T_bucket] ids + [B] lens; rows beyond the real
+        batch repeat row 0 (pure padding — results discarded)."""
+        import numpy as np
+
+        bb = self.config.batch_bucket(len(batch))
+        ids = np.zeros((bb, bucket), np.int32)
+        lens = np.zeros((bb,), np.int32)
+        for i, r in enumerate(batch):
+            ids[i, : len(r.ids)] = r.ids
+            lens[i] = len(r.ids)
+        for i in range(len(batch), bb):
+            ids[i] = ids[0]
+            lens[i] = lens[0]
+        return ids, lens
+
+    def _dispatch(self, entry, key, batch, engine=None, extra=None):
+        model_name, bucket, hooks_key = key
+        hooks = batch[0].hooks
+        host = hooks is not None  # rung 2 whenever hooks are present
+        groups = {model_name: (entry, batch)}
+        if extra:
+            groups.update(extra)
+        t0 = time.monotonic()
+        jit_failure_counted = False
+        try:
+            if engine is not None and len(groups) > 1:
+                packed = {
+                    name: self._pack(reqs, reqs[0].bucket)
+                    for name, (_, reqs) in groups.items()
+                }
+                results = engine.run_group(packed)
+                with self._lock:
+                    self._stats["batches_codispatch"] += 1
+            else:
+                ids, lens = self._pack(batch, bucket)
+                try:
+                    rows = entry.model.run_batch(ids, lens, hooks, host)
+                except Exception:
+                    if host or not self.config.host_fallback or not \
+                            getattr(entry.model, "can_host", False):
+                        raise
+                    # rung 2: jitted program failed; host-stepped
+                    # retry. The jit failure counts toward the breaker
+                    # ONCE, here — the outer handler must not count
+                    # the same dispatch again if the retry fails too.
+                    with self._lock:
+                        entry.breaker.record(False)
+                    jit_failure_counted = True
+                    rows = entry.model.run_batch(ids, lens, hooks, True)
+                    host = True
+                results = {model_name: rows}
+        except Exception as e:
+            with self._lock:
+                for name, (en, reqs) in groups.items():
+                    if not (jit_failure_counted and en is entry):
+                        en.breaker.record(False)
+                    self._stats["failed"] += len(reqs)
+                    for r in reqs:
+                        r._finish(exc=ServeError(
+                            f"{type(e).__name__}: {e}"
+                        ))
+            return
+        dt = time.monotonic() - t0
+        with self._lock:
+            self._stats["batches"] += 1
+            for name, (en, reqs) in groups.items():
+                en.breaker.record(True)
+                en.ewma_batch_s = (
+                    dt if en.ewma_batch_s == 0.0
+                    else 0.7 * en.ewma_batch_s + 0.3 * dt
+                )
+                en.dispatch_keys.add(
+                    (reqs[0].bucket, self.config.batch_bucket(len(reqs)),
+                     reqs[0].hooks_key is not None)
+                )
+                rows = results[name]
+                for i, r in enumerate(reqs):
+                    out = dict(rows[i])
+                    out.setdefault("path", "host" if host else "jit")
+                    r._finish(result=out)
+                    self._stats["completed"] += 1
+                    if host:
+                        self._stats["completed_host"] += 1

@@ -1,0 +1,241 @@
+"""TCP front end: length-prefixed JSON over a threaded socket server.
+
+Frame = 4-byte LE length + UTF-8 JSON. Request:
+
+    {"model": str, "ids": [int, ...], "deadline_ms": int?,
+     "hooks": str?}            # hooks = a model-registered hook name
+
+Response:
+
+    {"ok": true, "id": int, "tokens": [...], "score": float,
+     "path": "jit"|"host", "latency_ms": float}
+  | {"ok": false, "error": "overloaded"|"deadline"|"quarantined"|
+     "shutting_down"|"unknown_model"|"unknown_hook"|"execution"|
+     "bad_request"}
+
+Robustness contract (exercised by tests/test_serving_robustness.py
+with FlakyProxy RST/delay faults): a client that vanishes — RST
+mid-request, half-written frame, cut mid-response — costs the server
+exactly one connection-handler thread unwinding on OSError. The
+in-flight request still reaches a terminal state inside
+InferenceServer (nothing leaks), and every other connection keeps
+being served.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from paddle_tpu.serving.server import (
+    InferenceServer,
+    ServeError,
+    ServeRejected,
+)
+
+_MAX_FRAME = 1 << 24  # 16 MiB of JSON is garbage, not a request
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    body = json.dumps(obj).encode()
+    sock.sendall(struct.pack("<I", len(body)) + body)
+
+
+def recv_msg(sock: socket.socket):
+    """One frame, or None on clean EOF. Raises ConnectionError on a
+    torn frame or an absurd length."""
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            if hdr:
+                raise ConnectionError("torn frame header")
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    if n > _MAX_FRAME:
+        raise ConnectionError(f"frame length {n} exceeds limit")
+    body = b""
+    while len(body) < n:
+        chunk = sock.recv(n - len(body))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        body += chunk
+    return json.loads(body.decode())
+
+
+class ServingTCPServer:
+    """Accept loop + one handler thread per connection, all daemonic.
+    `stop()` closes the listener and the open connections; the
+    underlying InferenceServer is NOT shut down here (the CLI owns its
+    drain) so in-flight dispatches complete."""
+
+    def __init__(self, server: InferenceServer, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.server = server
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self._stopped = False
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name="serve-tcp", daemon=True
+        )
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns.append(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while True:
+                try:
+                    msg = recv_msg(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return  # torn/garbage client: drop the connection
+                if msg is None:
+                    return
+                resp = self._handle(msg)
+                try:
+                    send_msg(conn, resp)
+                except OSError:
+                    return  # client gone mid-response: request already
+                    # terminal server-side, nothing leaks
+        finally:
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, msg: dict) -> dict:
+        try:
+            model = msg["model"]
+            ids = msg["ids"]
+            deadline_s = (
+                msg["deadline_ms"] / 1e3 if "deadline_ms" in msg else None
+            )
+            hooks_name = msg.get("hooks")
+        except (KeyError, TypeError):
+            return {"ok": False, "error": "bad_request"}
+        try:
+            req = self.server.submit(model, ids, deadline_s=deadline_s,
+                                     hooks_name=hooks_name)
+        except ServeRejected as e:
+            return {"ok": False, "error": e.reason, "detail": str(e)}
+        except Exception as e:
+            # malformed payload (ids over the largest bucket, wrong
+            # dtype, ...): the client gets bad_request, not a dropped
+            # connection from a dead handler thread
+            return {"ok": False, "error": "bad_request",
+                    "detail": f"{type(e).__name__}: {e}"}
+        try:
+            # the scheduler enforces the deadline; the extra slack only
+            # bounds a wedged dispatch so the handler thread cannot
+            # block forever
+            out = req.result(
+                timeout=(req.deadline - req.t_submit) + 30.0
+            )
+        except ServeRejected as e:
+            return {"ok": False, "error": e.reason, "id": req.id}
+        except (ServeError, TimeoutError) as e:
+            return {"ok": False, "error": "execution", "detail": str(e),
+                    "id": req.id}
+        resp = {"ok": True, "id": req.id,
+                "latency_ms": round(req.latency_s * 1e3, 3)}
+        resp.update(out)
+        return resp
+
+    def stop_accepting(self):
+        """Close the listener only — established connections keep
+        being served. The drain sequence is stop_accepting() ->
+        InferenceServer.shutdown(drain=True) -> stop(), so clients
+        with in-flight requests receive their drained responses
+        instead of a reset."""
+        self._stopped = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def stop(self):
+        self.stop_accepting()
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+
+class ServeClient:
+    """Blocking single-connection client (tests + load generator).
+    Reconnects lazily after a connection error."""
+
+    def __init__(self, addr: str, connect_timeout: float = 5.0):
+        host, _, port = addr.rpartition(":")
+        self._host = host or "127.0.0.1"
+        self._port = int(port)
+        self._timeout = connect_timeout
+        self._sock = None
+
+    def _connect(self):
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._timeout
+        )
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+
+    def call(self, model: str, ids, deadline_ms: int = None,
+             hooks: str = None, timeout: float = None) -> dict:
+        if self._sock is None:
+            self._connect()
+        msg = {"model": model, "ids": list(map(int, ids))}
+        if deadline_ms is not None:
+            msg["deadline_ms"] = int(deadline_ms)
+        if hooks is not None:
+            msg["hooks"] = hooks
+        try:
+            # set every call: None restores blocking mode, so a
+            # timeout passed once cannot leak into later calls
+            self._sock.settimeout(timeout)
+            send_msg(self._sock, msg)
+            resp = recv_msg(self._sock)
+        except (OSError, ConnectionError):
+            self.close()
+            raise
+        if resp is None:
+            self.close()
+            raise ConnectionError("server closed connection")
+        return resp
+
+    def close(self):
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -83,6 +83,7 @@ class FlakyProxy:
         self._reset_budget = 0  # connections to RST after the request
         self._refuse = False  # close every connection immediately
         self._delay_s = 0.0  # added latency before forwarding starts
+        self._cut_after = 0  # RST after N response bytes (0 = off)
         self._conns: list = []
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -111,11 +112,19 @@ class FlakyProxy:
         with self._lock:
             self._delay_s = seconds
 
+    def cut_after(self, n_bytes: int) -> None:
+        """RST each new connection after `n_bytes` of RESPONSE bytes
+        have been relayed — the client receives a torn half-response
+        (a mid-reply network cut, not a clean close)."""
+        with self._lock:
+            self._cut_after = n_bytes
+
     def heal(self) -> None:
         with self._lock:
             self._refuse = False
             self._reset_budget = 0
             self._delay_s = 0.0
+            self._cut_after = 0
 
     def cut_existing(self) -> None:
         """RST every currently-open proxied connection (network
@@ -138,16 +147,18 @@ class FlakyProxy:
                 if reset:
                     self._reset_budget -= 1
                 delay_s = self._delay_s
+                cut_after = self._cut_after
             if refuse:
                 _rst_close(client)
                 continue
             threading.Thread(
                 target=self._serve,
-                args=(client, reset, delay_s),
+                args=(client, reset, delay_s, cut_after),
                 daemon=True,
             ).start()
 
-    def _serve(self, client: socket.socket, reset: bool, delay_s: float):
+    def _serve(self, client: socket.socket, reset: bool, delay_s: float,
+               cut_after: int = 0):
         try:
             upstream = socket.create_connection(self._target, timeout=5)
         except OSError:
@@ -176,7 +187,11 @@ class FlakyProxy:
             target=_pump, args=(client, upstream), daemon=True
         )
         t.start()
-        _pump(upstream, client)
+        _pump(upstream, client, limit=cut_after or None)
+        if cut_after:
+            # torn mid-response: RST both halves, no clean FIN
+            _rst_close(client)
+            _rst_close(upstream)
 
     def close(self):
         self._stopped = True
@@ -207,17 +222,26 @@ def _rst_close(s: socket.socket) -> None:
         pass
 
 
-def _pump(src: socket.socket, dst: socket.socket) -> None:
+def _pump(src: socket.socket, dst: socket.socket,
+          limit: int = None) -> None:
+    """Relay src -> dst; with `limit`, stop (returning to the caller,
+    which RSTs) once `limit` bytes have been forwarded."""
+    sent = 0
     try:
         while True:
             data = src.recv(65536)
             if not data:
                 break
+            if limit is not None and sent + len(data) >= limit:
+                dst.sendall(data[: max(limit - sent, 0)])
+                return  # caller tears the connection down with RST
             dst.sendall(data)
+            sent += len(data)
     except OSError:
         pass
     finally:
-        try:
-            dst.shutdown(socket.SHUT_WR)
-        except OSError:
-            pass
+        if limit is None:
+            try:
+                dst.shutdown(socket.SHUT_WR)
+            except OSError:
+                pass

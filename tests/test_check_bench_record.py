@@ -1,0 +1,74 @@
+"""tools/check_bench_record.py — the ROADMAP 5b tripwire.
+
+The full-row artifact guarantee (every row bench.py/bench_multichip.py
+emits also lands in BENCH_full_rNN.jsonl) is only as good as the lint
+that watches it; these tests pin both lint modes and prove the compare
+mode actually catches a dropped row."""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+))
+
+import check_bench_record as cbr  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_static_scan_is_clean():
+    """bench.py and bench_multichip.py route every row through
+    emit() — no stray print(json.dumps(...)) rows."""
+    assert cbr.check_static(REPO) == []
+
+
+def test_static_scan_catches_stray_print(tmp_path):
+    """A bench.py that prints a row without emit() is flagged."""
+    (tmp_path / "bench.py").write_text(
+        "import json\n"
+        "def emit(line):\n"
+        "    print(json.dumps(line))\n"
+        "def rogue(row):\n"
+        "    print(json.dumps(row))  # bypasses the artifact\n"
+    )
+    (tmp_path / "bench_multichip.py").write_text(
+        "from bench import emit\n"
+    )
+    violations = cbr.check_static(str(tmp_path))
+    assert violations and "bench.py:5" in violations[0]
+
+
+def test_compare_catches_dropped_row(tmp_path):
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+    rows = [{"metric": "a", "value": 1}, {"metric": "b", "value": 2}]
+    stdout.write_text(
+        "noise line\n" + "\n".join(json.dumps(r) for r in rows) + "\n"
+    )
+    record.write_text(json.dumps(rows[0]) + "\n")  # 'b' dropped
+    violations = cbr.check_compare(str(stdout), str(record))
+    assert violations and "'b'" in violations[0]
+    # complete record: clean
+    record.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert cbr.check_compare(str(stdout), str(record)) == []
+
+
+def test_cli_exit_codes(tmp_path):
+    r = subprocess.run(
+        [sys.executable, "tools/check_bench_record.py", "static"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    stdout = tmp_path / "s.txt"
+    record = tmp_path / "r.jsonl"
+    stdout.write_text(json.dumps({"metric": "x"}) + "\n")
+    record.write_text("")
+    r = subprocess.run(
+        [sys.executable, "tools/check_bench_record.py", "compare",
+         str(stdout), str(record)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 1 and "missing" in r.stderr

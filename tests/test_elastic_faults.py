@@ -604,6 +604,59 @@ class TestMasterClientRetries:
             # for pre-existing callers
             assert isinstance(ei.value, ConnectionError)
 
+    def test_session_survives_midsession_cut_and_delay(self, tmp_path):
+        """PR-8 satellite: a full WORK SESSION (add tasks, lease, ack,
+        finish the pass) against the networked master survives
+        mid-session connection faults — in-flight RST via
+        cut_existing(), an RST'd fresh connection, and added latency —
+        with every task done exactly once. Before this test only
+        single-call retry behavior was pinned; here the faults land
+        BETWEEN calls of one session, where a sloppy client would
+        cache a dead socket or double-ack a re-leased task."""
+        from conftest import start_master
+
+        from paddle_tpu.data.master_client import MasterClient
+        from paddle_tpu.testing_faults import FlakyProxy
+
+        master, port = start_master(lease="30")
+        try:
+            with FlakyProxy(("127.0.0.1", port)) as proxy:
+                c = MasterClient(f"127.0.0.1:{proxy.port}",
+                                 retry_seconds=20)
+                for i in range(6):
+                    c.add_task(f"task-{i}".encode())
+                # lease two tasks, then cut every open connection:
+                # the client's NEXT call must transparently reconnect
+                t1 = c.get_task()
+                t2 = c.get_task()
+                assert t1 is not None and t2 is not None
+                proxy.cut_existing()
+                assert c.task_done(t1[0])  # reconnects under the hood
+                # an RST that kills the RESPONSE of a delivered ack:
+                # the client retries, the duplicate ack returns False
+                # (lease already closed), and the task stays done
+                # exactly once — the at-least-once contract
+                proxy.reset_next(1)
+                c.close()  # force the doomed fresh connection
+                c.task_done(t2[0])  # must not raise; False on dup is ok
+                # added latency: calls still land, just slower
+                proxy.delay(0.2)
+                done = {t1[1], t2[1]}
+                while True:
+                    t = c.get_task()
+                    if t is None:
+                        break
+                    assert c.task_done(t[0])
+                    done.add(t[1])
+                proxy.heal()
+                assert done == {f"task-{i}".encode() for i in range(6)}
+                assert c.pass_finished()
+                counts = c.counts
+                assert counts["done"] >= 6 and counts["pending"] == 0
+        finally:
+            MasterClient(f"127.0.0.1:{port}", retry_seconds=1).shutdown()
+            master.wait(timeout=10)
+
     def test_protocol_error_fails_fast(self):
         """A peer speaking garbage is NOT retried for retry_seconds:
         MasterProtocolError surfaces immediately."""

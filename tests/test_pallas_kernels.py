@@ -344,3 +344,43 @@ class TestFusedBnActConv:
                 np.asarray(a), np.asarray(b), rtol=5e-2, atol=5e-2,
                 err_msg=name,
             )
+
+
+class TestFusedFamilyRetirement:
+    """ROADMAP 5a resolution: the fused-RNN family is formally retired
+    (PERF.md round-6 verdict — the scan wins every measured shape, GRU
+    never got a fused backward). These tests PIN the chosen behavior:
+    the auto policy never engages the kernels, and the explicit opt-in
+    flag warns DeprecationWarning exactly once per process."""
+
+    def test_auto_policy_never_engages(self):
+        from paddle_tpu.layers import recurrent as rec
+
+        reset_flags()
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any warning -> failure
+            assert rec._use_fused(128, 100, 256) is False
+            assert rec._use_fused() is False
+
+    def test_fused_optin_warns_deprecation(self):
+        from paddle_tpu.layers import recurrent as rec
+
+        rec._WARNED_FUSED_OPTIN.clear()
+        set_flag("use_pallas_rnn", True)
+        with pytest.warns(DeprecationWarning, match="RETIRED"):
+            assert rec._use_fused() is True
+        # once per process: a second engage stays silent (the bench
+        # A/B flips the flag per timing window)
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert rec._use_fused() is True
+        # explicit False opt-out: no warning either
+        rec._WARNED_FUSED_OPTIN.clear()
+        set_flag("use_pallas_rnn", False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert rec._use_fused() is False

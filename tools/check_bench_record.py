@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Lint: every bench row must land in the full-row artifact.
+
+ROADMAP 5b's guarantee — bench.py/bench_multichip.py append EVERY
+emitted row to `BENCH_full_rNN.jsonl` — regresses silently the moment
+someone prints a row without going through `bench.emit`. Two checks,
+both run by `tests/test_check_bench_record.py`:
+
+- **static**: AST-scan bench.py and bench_multichip.py. Any
+  `json.dumps(...)` call OUTSIDE `def emit` is a row (or the makings
+  of one) that can bypass the artifact — rows must flow through
+  emit(), which owns both the print and the append; bench_multichip
+  must import `emit` from bench and define no rival emitter.
+- **compare**: given a captured bench stdout and the jsonl artifact of
+  the same run, assert the multiset of stdout row ids ("metric" keys)
+  is contained in the artifact. A stdout row missing from the record
+  is exactly the regression 5b forbids.
+
+Usage:
+    python tools/check_bench_record.py static [repo_dir]
+    python tools/check_bench_record.py compare STDOUT_FILE RECORD_FILE
+
+Exit 0 = clean, 1 = violation (printed to stderr).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from collections import Counter
+
+BENCH_FILES = ("bench.py", "bench_multichip.py")
+
+
+def _is_json_dumps(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "dumps"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "json"
+    )
+
+
+def check_static(repo_dir: str) -> list:
+    """Return a list of violation strings (empty = clean)."""
+    violations = []
+    for fname in BENCH_FILES:
+        path = os.path.join(repo_dir, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+
+        emit_bodies = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "emit":
+                emit_bodies.extend(ast.walk(node))
+        inside_emit = set(map(id, emit_bodies))
+
+        for node in ast.walk(tree):
+            if id(node) in inside_emit:
+                continue
+            if _is_json_dumps(node):
+                # json.dumps ANYWHERE outside emit() is how a row gets
+                # printed without reaching the artifact (directly or
+                # via an intermediate variable) — rows must flow
+                # through emit(), which owns both the print and the
+                # append
+                violations.append(
+                    f"{fname}:{node.lineno}: json.dumps outside "
+                    f"emit() — a serialized row here can bypass "
+                    f"BENCH_full_rNN.jsonl"
+                )
+    # bench_multichip must route rows through bench.emit
+    mc = os.path.join(repo_dir, "bench_multichip.py")
+    with open(mc) as f:
+        mc_tree = ast.parse(f.read(), mc)
+    imports_emit = any(
+        isinstance(n, ast.ImportFrom)
+        and n.module == "bench"
+        and any(a.name == "emit" for a in n.names)
+        for n in ast.walk(mc_tree)
+    )
+    if not imports_emit:
+        violations.append(
+            "bench_multichip.py: does not import emit from bench — "
+            "its rows cannot reach the full-row artifact"
+        )
+    return violations
+
+
+def check_compare(stdout_path: str, record_path: str) -> list:
+    """Every JSON row printed to stdout must appear in the record, at
+    least as many times as it was printed."""
+    def rows(path):
+        out = Counter()
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if not ln.startswith("{"):
+                    continue
+                try:
+                    d = json.loads(ln)
+                except ValueError:
+                    continue
+                if isinstance(d, dict) and "metric" in d:
+                    out[d["metric"]] += 1
+        return out
+
+    printed, recorded = rows(stdout_path), rows(record_path)
+    violations = []
+    for metric, n in printed.items():
+        if recorded[metric] < n:
+            violations.append(
+                f"row {metric!r}: printed {n}x but recorded "
+                f"{recorded[metric]}x in {record_path} — a bench row "
+                f"is missing from the full-row artifact"
+            )
+    if not printed:
+        violations.append(f"{stdout_path}: no bench rows found")
+    return violations
+
+
+def main(argv) -> int:
+    if len(argv) >= 2 and argv[1] == "static":
+        repo = argv[2] if len(argv) > 2 else os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        violations = check_static(repo)
+    elif len(argv) == 4 and argv[1] == "compare":
+        violations = check_compare(argv[2], argv[3])
+    else:
+        print(__doc__, file=sys.stderr)
+        return 2
+    for v in violations:
+        print(f"check_bench_record: {v}", file=sys.stderr)
+    if not violations:
+        print("check_bench_record: OK")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
