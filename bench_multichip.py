@@ -406,6 +406,152 @@ def _bench_checkpoint_overhead(n_dev, synthetic):
     return out
 
 
+def _bench_preempt_recovery(n_dev, synthetic):
+    """Permanent recovery row (ISSUE 9): elasticity measured like
+    throughput. Two arms, both against the REAL trainer:
+
+      sigterm — a preemptible SGD worker subprocess is SIGTERMed
+                mid-pass; it finishes the in-flight batch, flushes a
+                mid-pass async checkpoint, exits EXIT_PREEMPTED (75);
+                a respawn auto-resumes. Measured: flush latency
+                (SIGTERM->exit), time-to-recover (respawn->first newly
+                trained batch, jit compile included — that IS the
+                recovery cost), and batches lost/retrained across the
+                whole run (both must be 0: the global-step record must
+                cover every batch exactly once).
+      nan     — an in-process trainer hits one poisoned batch with
+                skip_budget=0, forcing the rollback rung. Measured:
+                detection latency in batches (contract: 1), rollback
+                wall time, and batches of progress the rollback
+                discarded (bounded by the checkpoint cadence).
+
+    CPU smoke: timings are machine-relative; the loss-zero claims are
+    exact. `value` (headline) = time_to_recover seconds."""
+    import shutil
+    import signal
+    import tempfile
+
+    from paddle_tpu.testing_faults import (
+        read_worker_records,
+        start_preemptible_trainer,
+    )
+    from paddle_tpu.trainer import watchdog as wdg
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_preempt_")
+    save = os.path.join(work, "ckpt")
+    out_file = os.path.join(work, "out.jsonl")
+    num_passes, batches = 3, 16
+    total_steps = num_passes * batches
+
+    def _lines():
+        return read_worker_records(out_file)
+
+    try:
+        # ---- arm 1: SIGTERM mid-pass ----
+        p = start_preemptible_trainer(
+            repo, save, out_file, NUM_PASSES=num_passes,
+            BATCHES=batches, BATCH_SLEEP=0.05,
+        )
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if sum("loss" in ln for ln in _lines()) >= batches + 4:
+                break
+            time.sleep(0.05)
+        p.send_signal(signal.SIGTERM)
+        t0 = time.monotonic()
+        rc = p.wait(timeout=120)
+        flush_s = time.monotonic() - t0
+        if rc != wdg.EXIT_PREEMPTED:
+            raise RuntimeError(
+                f"worker exited {rc}, want {wdg.EXIT_PREEMPTED}: "
+                f"{p.stderr.read()[-500:]}"
+            )
+        steps_before = {ln["step"] for ln in _lines() if "loss" in ln}
+
+        t1 = time.monotonic()
+        p2 = start_preemptible_trainer(
+            repo, save, out_file, NUM_PASSES=num_passes,
+            BATCHES=batches,
+        )
+        recover_s = None
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            new = {ln["step"] for ln in _lines()
+                   if "loss" in ln} - steps_before
+            if new:
+                recover_s = time.monotonic() - t1
+                break
+            time.sleep(0.05)
+        rc2 = p2.wait(timeout=300)
+        if rc2 != 0 or recover_s is None:
+            raise RuntimeError(
+                f"resume failed rc={rc2}: {p2.stderr.read()[-500:]}"
+            )
+        steps = [ln["step"] for ln in _lines() if "loss" in ln]
+        lost = total_steps - len(set(steps))
+        retrained = len(steps) - len(set(steps))
+
+        # ---- arm 2: injected NaN -> rollback ----
+        shutil.rmtree(work, ignore_errors=True)
+        os.makedirs(work, exist_ok=True)
+        nan_at = 2 * batches + 4  # mid-pass 2: passes 0-1 checkpointed
+        p3 = start_preemptible_trainer(
+            repo, save, out_file, NUM_PASSES=num_passes,
+            BATCHES=batches, NAN_AT=nan_at, SKIP_BUDGET=0,
+            GOOD_BATCHES=2,
+        )
+        t2 = time.monotonic()
+        rc3 = p3.wait(timeout=600)
+        nan_wall_s = time.monotonic() - t2
+        if rc3 != 0:
+            raise RuntimeError(
+                f"nan arm exited {rc3}: {p3.stderr.read()[-500:]}"
+            )
+        report = next(ln["report"] for ln in _lines()
+                      if "report" in ln)
+        skips = [e for e in report["events"] if e["kind"] == "skip"]
+        rollbacks = [e for e in report["events"]
+                     if e["kind"] == "rollback"]
+        if not rollbacks:
+            raise RuntimeError(f"no rollback in report: {report}")
+        # detection latency, MEASURED from the event stream: the skip
+        # event's global_step minus the injected batch's step, plus 1
+        # (the contract is "within 1 batch" — fires ON the poisoned
+        # batch). A lagging verdict would read 2+ here, not stay 1.
+        detect_batches = (
+            skips[0]["global_step"] - nan_at + 1 if skips else -1
+        )
+        # progress discarded = steps from the restored checkpoint to
+        # the fault (they retrain after rollback)
+        batches_lost_nan = nan_at - rollbacks[0]["global_step"]
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    out = {
+        "value": round(recover_s, 3),
+        "unit": "s to first trained batch after preemption respawn",
+        "sigterm_flush_s": round(flush_s, 3),
+        "sigterm_batches_lost": lost,
+        "sigterm_batches_retrained": retrained,
+        "sigterm_exit_code": rc,
+        "nan_detect_batches": detect_batches,
+        "nan_rollbacks": report["rollbacks"],
+        "nan_batches_lost": batches_lost_nan,
+        "nan_run_wall_s": round(nan_wall_s, 3),
+        "devices": n_dev,
+        "passes": num_passes,
+        "batches_per_pass": batches,
+    }
+    if synthetic:
+        out["synthetic"] = True
+        out["note"] = (
+            "CPU smoke - loss-zero/exactly-once claims are exact, "
+            "absolute times are not"
+        )
+    return out
+
+
 def build_rows(n_dev):
     rows = []
     for model in ("alexnet", "googlenet"):
@@ -443,11 +589,16 @@ def mc_main(argv):
                                                    synthetic))
         for name, model, total in build_rows(n_dev)
     ]
-    # permanent elasticity row (ROADMAP item 4): checkpoint stalls are
-    # tracked like MFU, not assumed away
+    # permanent elasticity rows (ROADMAP item 4 / ISSUE 9): checkpoint
+    # stalls and preemption recovery are tracked like MFU, not assumed
+    # away
     rows.append((
         f"mc_checkpoint_overhead_dp{n_dev}",
         lambda: _bench_checkpoint_overhead(n_dev, synthetic),
+    ))
+    rows.append((
+        f"mc_preempt_recovery_dp{n_dev}",
+        lambda: _bench_preempt_recovery(n_dev, synthetic),
     ))
     for name, fn in rows:
         if pattern and pattern not in name:

@@ -11,7 +11,6 @@ program runs SPMD over the device mesh.
 
 from __future__ import annotations
 
-from paddle_tpu.core import rng as _rng
 from paddle_tpu.evaluators import create_evaluator
 from paddle_tpu.trainer.trainer import SGD as _Engine
 
@@ -101,20 +100,10 @@ class SGD:
                                             batch_id=batch_id)
                 )
                 feed = feeder(data_batch)
-                step_rng = _rng.split_for_step(
-                    engine.step_key, engine.global_step
-                )
-                (
-                    engine.params,
-                    engine.opt_state,
-                    engine.state,
-                    loss,
-                    outs,
-                ) = engine.step_fn(
-                    engine.params, engine.opt_state, engine.state, feed,
-                    engine.global_step, step_rng,
-                )
-                engine.global_step += 1
+                # run_step understands the engine's watchdog mode
+                # (the step returns a [loss, finite] health vector and
+                # skips non-finite updates on device)
+                cost, _finite, outs = engine.run_step(feed)
                 batch_results = {}
                 for conf, ev in zip(
                     self.__topology__.evaluator_confs, pass_evals
@@ -127,7 +116,7 @@ class SGD:
                     v2_event.EndIteration(
                         pass_id=pass_id,
                         batch_id=batch_id,
-                        cost=float(loss),
+                        cost=cost,
                         evaluator=v2_event.EvalResults(batch_results),
                     )
                 )

@@ -73,6 +73,7 @@ def cmd_train(args):
     from paddle_tpu.launch import distributed_init_from_env
     from paddle_tpu.trainer import SGD
     from paddle_tpu.trainer import events
+    from paddle_tpu.trainer import watchdog as wdg
 
     # under `paddle launch` every worker carries the rendezvous env —
     # join it before any device use (cluster_train trainer_id wiring)
@@ -160,13 +161,40 @@ def cmd_train(args):
                 f"cost {ev.cost:.6f}"
             )
 
-    trainer.train(
-        reader=reader,
-        feeder=feeder,
-        num_passes=args.num_passes,
-        event_handler=handler,
-        save_dir=args.save_dir or None,
-    )
+    # auto-resume: a respawned (preempted or crashed) worker picks up
+    # from the newest complete checkpoint in save_dir — including a
+    # MID-PASS preemption flush, which resumes at the exact batch
+    # (--from_scratch opts out)
+    start_pass = 0
+    if args.save_dir and not args.from_scratch:
+        try:
+            start_pass = trainer.resume(args.save_dir)
+            print(
+                f"resuming from {args.save_dir}: start pass "
+                f"{start_pass}, skip {trainer._resume_skip_batches} "
+                f"batches", flush=True,
+            )
+        except (FileNotFoundError, ValueError):
+            pass  # no (complete) checkpoint yet: fresh start
+    try:
+        trainer.train(
+            reader=reader,
+            feeder=feeder,
+            num_passes=args.num_passes,
+            event_handler=handler,
+            save_dir=args.save_dir or None,
+            start_pass=start_pass,
+        )
+    except wdg.Preempted as p:
+        # the contract launch.py keys on: checkpoint flushed, exit
+        # EXIT_PREEMPTED (75), respawn resumes losslessly
+        print(f"PREEMPTED pass {p.pass_id} batch {p.batches_done}",
+              flush=True)
+        return wdg.EXIT_PREEMPTED
+    except wdg.WatchdogAbort as a:
+        print("WATCHDOG_ABORT " + json.dumps(a.report.to_dict()),
+              flush=True)
+        return 1
     return 0
 
 
@@ -452,6 +480,9 @@ def main(argv=None):
     sp.add_argument("--num_passes", type=int, default=1)
     sp.add_argument("--save_dir", default="")
     sp.add_argument("--log_period", type=int, default=10)
+    sp.add_argument("--from_scratch", action="store_true",
+                    help="ignore existing checkpoints in --save_dir "
+                         "instead of auto-resuming")
     sp.set_defaults(fn=cmd_train)
 
     sp = sub.add_parser("dump_config", help="print config as JSON")
@@ -518,6 +549,10 @@ def main(argv=None):
                     help="coordinator port on the first host")
     sp.add_argument("--ssh-opts", default="",
                     help="extra ssh options, e.g. '-i key.pem'")
+    sp.add_argument("--max-respawns", type=int, default=3,
+                    dest="max_respawns",
+                    help="per-rank restarts after a preemption exit "
+                         "(code 75) before it counts as a failure")
     sp.add_argument("command", nargs=argparse.REMAINDER,
                     help="the per-process command (after --), e.g. "
                          "python -m paddle_tpu train --config cfg.py")

@@ -22,6 +22,11 @@ _DEFAULTS: dict[str, Any] = {
     # FP-exception trap (reference enables feenableexcept at trainer
     # start, trainer/TrainerMain.cpp:49): aborts on NaN-producing ops
     "trap_fp": False,
+    # training watchdog (trainer/watchdog.py): on-device non-finite
+    # skip + EWMA spike ladder + checkpoint rollback + SIGTERM-safe
+    # preemption. False disables (raw 2017 semantics: a NaN batch
+    # poisons the params silently).
+    "watchdog": True,
     # PRNG implementation: None = jax default (threefry). "rbg" is
     # substantially faster on TPU for dropout-heavy models (~27% whole
     # -step on AlexNet) at the cost of weaker shard-stability guarantees

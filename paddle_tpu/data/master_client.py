@@ -15,9 +15,12 @@ herd of trainers hammering a restarting master). Connection-shaped
 errors (refused/reset/EOF/timeout: the master is restarting from its
 snapshot, go/master/service.go:166-207) retry; malformed frames are a
 `MasterProtocolError` and fail fast — retrying a peer that speaks the
-wrong protocol only hides a real bug. When the deadline expires the
-caller gets a `MasterRetryTimeout` naming the address, elapsed time
-and attempt count instead of a generic socket error. Lease state
+wrong protocol only hides a real bug. Every recv is bounded by the
+remaining retry budget, so a master that ACCEPTS but never answers (a
+black hole) still trips the deadline instead of hanging the trainer
+on an unbounded read. When the deadline expires the caller gets a
+`MasterRetryTimeout` naming the address, elapsed time and attempt
+count instead of a generic socket error. Lease state
 lives on the server, so a client reconnect does not lose or duplicate
 tasks.
 """
@@ -85,7 +88,6 @@ class MasterClient:
             (self._host, self._port), timeout=self._timeout
         )
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        s.settimeout(None)  # calls block until the master answers
         self._sock = s
 
     def _recv_full(self, n: int) -> bytes:
@@ -97,9 +99,19 @@ class MasterClient:
             out += chunk
         return out
 
-    def _call_once(self, op: int, body: bytes) -> tuple:
+    def _call_once(self, op: int, body: bytes,
+                   timeout: Optional[float] = None) -> tuple:
+        """One framed request/response. EVERY send/recv is bounded by
+        `timeout` (default: the connect timeout) — a master that
+        accepts but never answers (a black-hole failure: alive at TCP,
+        dead at the protocol layer) surfaces as socket.timeout and
+        enters the normal retry path instead of hanging the trainer
+        forever past its retry deadline."""
         if self._sock is None:
             self._connect()
+        self._sock.settimeout(
+            self._timeout if timeout is None else timeout
+        )
         frame = struct.pack("<IB", 1 + len(body), op) + body
         self._sock.sendall(frame)
         (rlen,) = struct.unpack("<I", self._recv_full(4))
@@ -130,13 +142,26 @@ class MasterClient:
 
         Connection errors retry with capped full-jitter backoff until
         `retry_seconds`, then raise MasterRetryTimeout; malformed
-        frames raise MasterProtocolError immediately."""
+        frames raise MasterProtocolError immediately. Each attempt's
+        recv is bounded by the REMAINING retry budget (never less than
+        the connect timeout, so a late first attempt still gets a fair
+        read window) — the deadline fires even against a master that
+        accepts and then goes silent. `min_timeout` raises the
+        per-attempt floor for ops the server legitimately parks
+        (save-model election blocks up to its block_seconds)."""
         start = time.monotonic()
         deadline = start + self._retry
         attempt = 0
+        min_timeout = self._timeout
+        if op == _OP_REQUEST_SAVE:
+            (block_s,) = struct.unpack("<d", body[:8])
+            min_timeout = max(min_timeout, block_s + 5.0)
         while True:
             try:
-                return self._call_once(op, body)
+                remaining = deadline - time.monotonic()
+                return self._call_once(
+                    op, body, timeout=max(remaining, min_timeout)
+                )
             except MasterProtocolError:
                 raise  # alive-but-wrong peer: retrying hides the bug
             except (OSError, ConnectionError) as e:

@@ -196,7 +196,19 @@ def read_proto_data(path: str, compressed: bool | None = None):
     return defs, rows
 
 
-def read_proto_data_raw(path: str, compressed: bool | None = None):
+def read_proto_data_raw(path: str, compressed: bool | None = None,
+                        skip_bad_records: int = 0):
+    """`skip_bad_records=N`: up to N records that fail to parse
+    (bit-flipped media, torn writes) are dropped with a counted
+    warning instead of aborting the pass — the reader's half of the
+    watchdog's bad-data story. A corrupted varint LENGTH can desync
+    the frame stream; a desync surfaces as parse failures and is
+    bounded by the same budget, so a rotten file still fails loudly
+    once the budget is spent. 0 = strict (any bad record raises).
+    The header must always parse — without slot types nothing after
+    it is interpretable."""
+    import logging
+
     with open(path, "rb") as f:
         raw = f.read()
     if compressed or (compressed is None and raw[:2] == b"\x1f\x8b"):
@@ -206,26 +218,56 @@ def read_proto_data_raw(path: str, compressed: bool | None = None):
         header = parse_header(next(msgs))
     except StopIteration:
         return [], [], []
-    n_vec = sum(
-        1 for t, _ in header
-        if t in (VECTOR_DENSE, VECTOR_SPARSE_NON_VALUE,
-                 VECTOR_SPARSE_VALUE, VAR_MDIM_DENSE, STRING)
-    )
     rows, begins = [], []
-    for m in msgs:
-        s = _parse_sample(m)
-        slots = []
-        vi = ii = 0
-        for t, dim in header:
-            if t == INDEX:
-                slots.append(int(s["id_slots"][ii]))
-                ii += 1
-            elif t == VAR_MDIM_INDEX:
-                slots.append(list(s["var_id_slots"][vi]["ids"]))
-                vi += 1
-            else:
-                slots.append(_vector_to_slot(t, s["vector_slots"][vi]))
-                vi += 1
+    bad = 0
+    while True:
+        try:
+            m = next(msgs)
+        except StopIteration:
+            break
+        except Exception as e:
+            # framing (varint) error: the rest of the stream is
+            # unrecoverable — count it as ONE bad record and stop
+            bad += 1
+            if bad > skip_bad_records:
+                raise ValueError(
+                    f"{path}: corrupt record stream ({e}); "
+                    f"{bad} bad record(s), budget {skip_bad_records}"
+                ) from e
+            logging.getLogger("paddle_tpu.data").warning(
+                "%s: frame stream desynced (%s); dropping the tail "
+                "(%d/%d skips used)", path, e, bad, skip_bad_records,
+            )
+            break
+        try:
+            s = _parse_sample(m)
+            slots = []
+            vi = ii = 0
+            for t, dim in header:
+                if t == INDEX:
+                    slots.append(int(s["id_slots"][ii]))
+                    ii += 1
+                elif t == VAR_MDIM_INDEX:
+                    slots.append(list(s["var_id_slots"][vi]["ids"]))
+                    vi += 1
+                else:
+                    slots.append(
+                        _vector_to_slot(t, s["vector_slots"][vi])
+                    )
+                    vi += 1
+        except Exception as e:
+            bad += 1
+            if bad > skip_bad_records:
+                raise ValueError(
+                    f"{path}: undecodable record ({type(e).__name__}: "
+                    f"{e}); {bad} bad record(s), budget "
+                    f"{skip_bad_records}"
+                ) from e
+            logging.getLogger("paddle_tpu.data").warning(
+                "%s: skipping undecodable record (%s) — %d/%d skips "
+                "used", path, type(e).__name__, bad, skip_bad_records,
+            )
+            continue
         rows.append(tuple(slots))
         begins.append(s["is_beginning"])
     return header, rows, begins
@@ -246,16 +288,20 @@ def group_sequences(rows, begins):
     return out
 
 
-def proto_reader(paths, compressed=None):
+def proto_reader(paths, compressed=None, skip_bad_records: int = 0):
     """Reader over ProtoDataProvider files (the reader-combinator
     entry): yields per-sample slot tuples; multi-row sequences arrive
-    in the feeder's sequence shape."""
+    in the feeder's sequence shape. `skip_bad_records` bounds how many
+    corrupt records per FILE are dropped (with a warning) before the
+    pass aborts — see read_proto_data_raw."""
     if isinstance(paths, str):
         paths = [paths]
 
     def reader():
         for p in paths:
-            _, rows, begins = read_proto_data_raw(p, compressed)
+            _, rows, begins = read_proto_data_raw(
+                p, compressed, skip_bad_records=skip_bad_records
+            )
             if all(begins):
                 yield from rows
             else:

@@ -50,6 +50,7 @@ class DataProvider:
         should_shuffle: Optional[bool] = None,
         cache: int = CacheType.NO_CACHE,
         init_hook: Optional[Callable] = None,
+        skip_faulty_files: int = 0,
         **kwargs,
     ):
         self.fn = fn
@@ -57,6 +58,14 @@ class DataProvider:
         self.should_shuffle = should_shuffle
         self.cache = cache
         self.init_hook = init_hook
+        # data-pipeline robustness: a file whose process() raises
+        # (corrupt/undecodable) is SKIPPED with a counted warning, up
+        # to this budget per reader pass, instead of killing the whole
+        # pass. 0 = strict (any decode error aborts — the historical
+        # behavior). The granularity is per FILE because a raised user
+        # generator cannot be resumed mid-record.
+        self.skip_faulty_files = skip_faulty_files
+        self.faulty_files_skipped = 0  # running total, across passes
         self.kwargs = kwargs
         # per-file-list cache: one decorated fn commonly serves both a
         # train and a test reader (PyDataProvider2 caches per provider
@@ -95,8 +104,21 @@ class DataProvider:
         use_cache = self.cache == CacheType.CACHE_PASS_IN_MEM
 
         def generate():
+            skipped = 0
             for path in file_list:
-                yield from self.fn(settings, path)
+                try:
+                    yield from self.fn(settings, path)
+                except Exception as e:
+                    if skipped >= self.skip_faulty_files:
+                        raise
+                    skipped += 1
+                    self.faulty_files_skipped += 1
+                    settings.logger.warning(
+                        "provider: skipping faulty file %s (%s: %s) — "
+                        "%d/%d skips used this pass",
+                        path, type(e).__name__, e, skipped,
+                        self.skip_faulty_files,
+                    )
 
         def reader():
             if not use_cache and not shuffle:
@@ -126,6 +148,7 @@ def provider(
     should_shuffle=None,
     cache: int = CacheType.NO_CACHE,
     init_hook: Optional[Callable] = None,
+    skip_faulty_files: int = 0,
     **kwargs,
 ):
     """Decorator (PyDataProvider2.py:329):
@@ -135,6 +158,9 @@ def provider(
         def process(settings, filename):
             for img, lbl in read(filename):
                 yield img, lbl
+
+    `skip_faulty_files=N` lets a pass survive up to N corrupt/
+    undecodable files (counted warning per skip) instead of aborting.
     """
     assert input_types is not None or init_hook is not None, (
         "provider needs input_types (directly or set by init_hook)"
@@ -147,6 +173,7 @@ def provider(
             should_shuffle=should_shuffle,
             cache=cache,
             init_hook=init_hook,
+            skip_faulty_files=skip_faulty_files,
             **kwargs,
         )
 

@@ -79,53 +79,68 @@ def launch(
     coordinator_port: int = 7164,
     ssh_opts=(),
     extra_env=None,
+    max_respawns: int = 3,
 ) -> int:
     """Start `command` on every host with the rendezvous environment;
     wait for all; kill the survivors if any process fails. Returns the
-    first non-zero exit code (0 = all succeeded)."""
+    first non-zero exit code (0 = all succeeded).
+
+    A rank that exits with `EXIT_PREEMPTED` (75 — the trainer's
+    SIGTERM contract, trainer/watchdog.py) is NOT a failure: it
+    flushed a checkpoint and asked to be restarted, so the launcher
+    respawns it in place (up to `max_respawns` times per rank) and the
+    respawned trainer auto-resumes from the flushed checkpoint."""
+    from paddle_tpu.trainer.watchdog import EXIT_PREEMPTED
+
     if isinstance(hosts, str):
         hosts = [h.strip() for h in hosts.split(",") if h.strip()]
     world = len(hosts) * nproc_per_host
     coord_host = hosts[0] if not _is_local(hosts[0]) else "127.0.0.1"
     coord = f"{coord_host}:{coordinator_port}"
 
+    def _spawn(host, rank):
+        env_kv = {
+            "PADDLE_COORDINATOR": coord,
+            "PADDLE_NUM_PROCESSES": str(world),
+            "PADDLE_PROCESS_ID": str(rank),
+            **(extra_env or {}),
+        }
+        if _is_local(host):
+            p = subprocess.Popen(
+                command,
+                env={**os.environ, **env_kv},
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        else:
+            # the reference's fabric run() ≙ plain ssh; quoting via
+            # shlex so the command survives the remote shell
+            remote = "cd {wd} && {env} {cmd}".format(
+                wd=shlex.quote(os.getcwd()),
+                env=" ".join(
+                    f"{k}={shlex.quote(v)}" for k, v in env_kv.items()
+                ),
+                cmd=" ".join(shlex.quote(c) for c in command),
+            )
+            p = subprocess.Popen(
+                ["ssh", *ssh_opts, host, remote],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        _stream(p, f"rank{rank}@{host}")
+        return p
+
+    slots = []  # rank -> (host,)
     procs = []
     rank = 0
     for host in hosts:
         for _ in range(nproc_per_host):
-            env_kv = {
-                "PADDLE_COORDINATOR": coord,
-                "PADDLE_NUM_PROCESSES": str(world),
-                "PADDLE_PROCESS_ID": str(rank),
-                **(extra_env or {}),
-            }
-            if _is_local(host):
-                p = subprocess.Popen(
-                    command,
-                    env={**os.environ, **env_kv},
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                )
-            else:
-                # the reference's fabric run() ≙ plain ssh; quoting via
-                # shlex so the command survives the remote shell
-                remote = "cd {wd} && {env} {cmd}".format(
-                    wd=shlex.quote(os.getcwd()),
-                    env=" ".join(
-                        f"{k}={shlex.quote(v)}" for k, v in env_kv.items()
-                    ),
-                    cmd=" ".join(shlex.quote(c) for c in command),
-                )
-                p = subprocess.Popen(
-                    ["ssh", *ssh_opts, host, remote],
-                    stdout=subprocess.PIPE,
-                    stderr=subprocess.STDOUT,
-                    text=True,
-                )
-            _stream(p, f"rank{rank}@{host}")
-            procs.append(p)
+            slots.append(host)
+            procs.append(_spawn(host, rank))
             rank += 1
+    respawns = [0] * len(procs)
 
     rc = 0
     try:
@@ -134,13 +149,26 @@ def launch(
         # collective waiting for it.
         import time as _time
 
-        live = list(procs)
+        live = list(range(len(procs)))
         while live:
-            for p in list(live):
-                code = p.poll()
+            for r in list(live):
+                code = procs[r].poll()
                 if code is None:
                     continue
-                live.remove(p)
+                if (code == EXIT_PREEMPTED
+                        and respawns[r] < max_respawns):
+                    # preemption, not failure: restart the rank; its
+                    # trainer resumes from the flushed checkpoint
+                    respawns[r] += 1
+                    sys.stdout.write(
+                        f"[launch] rank{r} preempted (exit "
+                        f"{EXIT_PREEMPTED}); respawn "
+                        f"{respawns[r]}/{max_respawns}\n"
+                    )
+                    sys.stdout.flush()
+                    procs[r] = _spawn(slots[r], r)
+                    continue
+                live.remove(r)
                 if code and not rc:
                     rc = code
                     # fail fast: a dead member blocks the collective
@@ -165,4 +193,5 @@ def main(args) -> int:
         nproc_per_host=args.nproc_per_host,
         coordinator_port=args.port,
         ssh_opts=shlex.split(args.ssh_opts) if args.ssh_opts else (),
+        max_respawns=getattr(args, "max_respawns", 3),
     )

@@ -189,10 +189,16 @@ class Optimizer:
             st[k] = s
         return st
 
-    def update(self, grads: dict, params: dict, state: dict, step) -> tuple:
+    def update(self, grads: dict, params: dict, state: dict, step,
+               lr_scale=None) -> tuple:
         """Returns (new_params, new_state). `step` is the global batch
-        counter (0-based)."""
+        counter (0-based). `lr_scale` (optional traced scalar) scales
+        the scheduled LR for this step — the watchdog's spike-backoff
+        rung; scaling here (not the gradients) keeps adaptive moments
+        (Adam m/v, Adagrad accumulators) fed with the TRUE gradient."""
         lr = lr_at(self.conf, step)
+        if lr_scale is not None:
+            lr = lr * lr_scale
         new_p, new_s = {}, {}
         for k, p in params.items():
             h = self.hypers.get(k, ParamHyper())

@@ -19,6 +19,7 @@ from functools import partial
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -61,7 +62,18 @@ class TrainStep:
     With a mesh, the feed is sharded over DATA_AXIS and params/opt-state
     are placed per `param_sharding`; XLA emits the gradient allreduce over
     ICI (the compiled replacement for ADD_GRADIENT + barriers,
-    ParameterService.proto:24-41)."""
+    ParameterService.proto:24-41).
+
+    With `watchdog=True` the step additionally computes an on-device
+    all-finite reduction over the loss and every gradient leaf, SKIPS
+    the whole update when any value is non-finite (params, opt-state
+    and layer state keep their previous values — a bad batch can never
+    poison the model), takes an `lr_scale` operand (the watchdog's
+    backoff/re-warm multiplier; a traced scalar, so changing it never
+    recompiles), and returns a 2-float `health` vector
+    `[loss, all_finite]` IN PLACE of the scalar loss — the finiteness
+    verdict rides the loss fetch the trainer already pays for, so the
+    happy path adds zero device->host transfers."""
 
     def __init__(
         self,
@@ -71,11 +83,13 @@ class TrainStep:
         donate=True,
         keep_outputs=None,
         sharding_rules=None,
+        watchdog=False,
     ):
         self.net = net
         self.opt = opt
         self.mesh = mesh
         self.sharding_rules = sharding_rules
+        self.watchdog = watchdog
         # Only declared outputs survive the step: returning every layer's
         # activations would pin all intermediates in HBM and block XLA
         # fusion/rematerialization.
@@ -83,15 +97,39 @@ class TrainStep:
             net.cost_names
         )
 
-        def step(params, opt_state, state, feed, step_i, rng):
+        def step(params, opt_state, state, feed, step_i, rng,
+                 lr_scale=None):
             (loss, (outs, new_state)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True
             )(params, feed, state=state, train=True, rng=rng)
             new_params, new_opt_state = opt.update(
-                grads, params, opt_state, step_i
+                grads, params, opt_state, step_i, lr_scale=lr_scale
             )
             outs = {k: v for k, v in outs.items() if k in keep}
-            return new_params, new_opt_state, new_state, loss, outs
+            if not watchdog:
+                return new_params, new_opt_state, new_state, loss, outs
+            # all-finite reduction, fused into the update program: a
+            # handful of per-leaf reductions + ANDs, no extra pass over
+            # activations and no host sync
+            finite = jnp.isfinite(loss)
+            for g in jax.tree_util.tree_leaves(grads):
+                finite = finite & jnp.all(jnp.isfinite(g))
+
+            def _keep(new, old):
+                return jnp.where(finite, new, old)
+
+            new_params = jax.tree_util.tree_map(
+                _keep, new_params, params
+            )
+            new_opt_state = jax.tree_util.tree_map(
+                _keep, new_opt_state, opt_state
+            )
+            new_state = jax.tree_util.tree_map(_keep, new_state, state)
+            health = jnp.stack([
+                loss.astype(jnp.float32),
+                finite.astype(jnp.float32),
+            ])
+            return new_params, new_opt_state, new_state, health, outs
 
         if mesh is not None:
             from paddle_tpu.parallel.sharding import Sharder
@@ -138,9 +176,17 @@ class TrainStep:
         )
         return p, o, s
 
-    def __call__(self, params, opt_state, state, feed, step_i, rng):
+    def __call__(self, params, opt_state, state, feed, step_i, rng,
+                 lr_scale=None):
         if self.mesh is not None:
             feed = shard_batch(feed, self.mesh)
+        if self.watchdog:
+            # always pass the scale so the traced signature is stable;
+            # a changed float re-dispatches, never recompiles
+            return self._step(
+                params, opt_state, state, feed, step_i, rng,
+                1.0 if lr_scale is None else float(lr_scale),
+            )
         return self._step(params, opt_state, state, feed, step_i, rng)
 
     def aot(self, params, opt_state, state, feed, step_i, rng):
@@ -155,11 +201,12 @@ class TrainStep:
         step is compiled once."""
         if self.mesh is not None:
             feed = shard_batch(feed, self.mesh)
-        compiled = self._step.lower(
-            params, opt_state, state, feed, step_i, rng
-        ).compile()
+        args = (params, opt_state, state, feed, step_i, rng)
+        if self.watchdog:
+            args += (1.0,)
+        compiled = self._step.lower(*args).compile()
 
         def run():
-            return compiled(params, opt_state, state, feed, step_i, rng)
+            return compiled(*args)
 
         return run, compiled.as_text()

@@ -14,6 +14,10 @@ place the tests get their violence from:
   master restart.
 - `truncate_file` / `corrupt_file`: tear or bit-flip a checkpoint
   shard to exercise manifest rejection and fallback.
+- `start_preemptible_trainer`: a REAL SGD trainer subprocess with
+  checkpointing + auto-resume, the target for SIGTERM-preemption and
+  NaN-injection experiments (shared by tests/test_elastic_faults.py
+  and the `mc_preempt_recovery` bench row).
 
 Test-support code, but shipped in the package (like the reference's
 paddle/cuda stubs) so downstream users can fault-test their own
@@ -26,6 +30,8 @@ import os
 import signal
 import socket
 import struct
+import subprocess
+import sys
 import threading
 
 
@@ -61,6 +67,146 @@ def corrupt_file(path: str, offset: int = None, nbytes: int = 8) -> None:
         f.write(bytes(b ^ 0xFF for b in chunk))
 
 
+# ---- preemptible trainer worker -------------------------------------
+#
+# A tiny but REAL training job (fc classifier, deterministic data,
+# async checkpoints each pass) that auto-resumes from SAVE_DIR and
+# appends one JSON line per trained batch to OUT_FILE:
+#     {"pass": p, "bi": i, "step": g, "loss": c}
+#     {"resume": start_pass, "skip": k}     on auto-resume
+#     {"preempted": pass, "bi": n}          before exiting 75
+#     {"done": true}                        on completion
+# NAN_AT (a global step index) poisons that batch's features with NaN
+# — the watchdog must skip/rollback it, never the operator.
+PREEMPTIBLE_TRAINER_SRC = """
+import json, os, sys, time
+sys.path.insert(0, os.environ["REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from paddle_tpu import dsl
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.data import reader as R
+from paddle_tpu.data.feeder import DataFeeder, dense_vector, integer_value
+from paddle_tpu.trainer import EndIteration, SGD
+from paddle_tpu.trainer import watchdog as wdg
+
+save_dir = os.environ["SAVE_DIR"]
+out = open(os.environ["OUT_FILE"], "a")
+num_passes = int(os.environ.get("NUM_PASSES", "3"))
+batches = int(os.environ.get("BATCHES", "16"))
+nan_at = int(os.environ.get("NAN_AT", "-1"))
+skip_budget = int(os.environ.get("SKIP_BUDGET", "5"))
+good_batches = int(os.environ.get("GOOD_BATCHES", "4"))
+# widen the preemption window: pretend each step costs this long (the
+# CPU-smoke model trains a batch in ~ms; real steps take 100ms+)
+batch_sleep = float(os.environ.get("BATCH_SLEEP", "0"))
+
+with dsl.model() as g:
+    x = dsl.data("x", (6,))
+    y = dsl.data("y", (1,), is_ids=True)
+    h = dsl.fc(x, size=8, act="tanh")
+    o = dsl.fc(h, size=3, name="output")
+    dsl.classification_cost(o, y)
+
+rng = np.random.default_rng(5)
+W = rng.standard_normal((6, 3))
+xs = rng.standard_normal((batches * 4, 6)).astype(np.float32)
+ys = np.argmax(xs @ W, axis=1).astype(np.int64)
+data = [(xs[i], int(ys[i])) for i in range(len(xs))]
+
+def reader():
+    yield from data
+
+feeder = DataFeeder({"x": 0, "y": 1},
+                    {"x": dense_vector(6), "y": integer_value(3)})
+wd_conf = wdg.WatchdogConfig(skip_budget=skip_budget,
+                             good_batches=good_batches)
+trainer = SGD(g.conf, OptimizationConf(
+    learning_method="adam", learning_rate=0.05), seed=11,
+    watchdog=wd_conf)
+
+if nan_at >= 0:
+    # poison ONE batch's features, keyed on a MONOTONIC feed counter
+    # (not global_step, which rewinds on rollback): the fault is
+    # transient, like a bad record that streams past once
+    import dataclasses
+    base_feeder = feeder
+    fed = [0]
+    def feeder(raw):
+        f = base_feeder(raw)
+        if fed[0] == nan_at:
+            f["x"] = dataclasses.replace(
+                f["x"], value=np.full_like(f["x"].value, np.nan))
+        fed[0] += 1
+        return f
+
+start = 0
+try:
+    start = trainer.resume(save_dir)
+    out.write(json.dumps({"resume": start,
+                          "skip": trainer._resume_skip_batches})
+              + "\\n")
+    out.flush()
+except (FileNotFoundError, ValueError):
+    pass
+
+def handler(e):
+    if isinstance(e, EndIteration):
+        out.write(json.dumps({"pass": e.pass_id, "bi": e.batch_id,
+                              "step": trainer.global_step - 1,
+                              "loss": e.cost}) + "\\n")
+        out.flush()
+        if batch_sleep:
+            time.sleep(batch_sleep)
+
+try:
+    trainer.train(reader=R.batched(reader, 4), feeder=feeder,
+                  num_passes=num_passes, start_pass=start,
+                  event_handler=handler, save_dir=save_dir,
+                  checkpoint_mode="async")
+except wdg.Preempted as p:
+    out.write(json.dumps({"preempted": p.pass_id,
+                          "bi": p.batches_done}) + "\\n")
+    out.flush()
+    sys.exit(wdg.EXIT_PREEMPTED)
+if trainer.last_watchdog_report is not None:
+    out.write(json.dumps(
+        {"report": trainer.last_watchdog_report.to_dict()}) + "\\n")
+out.write(json.dumps({"done": True}) + "\\n")
+out.flush()
+"""
+
+
+def read_worker_records(out_file: str) -> list:
+    """Parse the preemptible worker's OUT_FILE (one JSON dict per
+    line; schema documented on PREEMPTIBLE_TRAINER_SRC). Shared by
+    the elastic-fault tests and the mc_preempt_recovery bench row so
+    a record-format change breaks in one place, loudly."""
+    import json
+
+    if not os.path.exists(out_file):
+        return []
+    with open(out_file) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def start_preemptible_trainer(repo: str, save_dir: str, out_file: str,
+                              **env_overrides) -> subprocess.Popen:
+    """Launch the preemptible SGD worker above. `env_overrides` set
+    the worker knobs (NUM_PASSES, BATCHES, NAN_AT, SKIP_BUDGET,
+    GOOD_BATCHES) as strings."""
+    env = dict(
+        os.environ, REPO=repo, SAVE_DIR=save_dir, OUT_FILE=out_file,
+        **{k: str(v) for k, v in env_overrides.items()},
+    )
+    return subprocess.Popen(
+        [sys.executable, "-c", PREEMPTIBLE_TRAINER_SRC], env=env,
+        cwd=repo, stderr=subprocess.PIPE, text=True,
+    )
+
+
 class FlakyProxy:
     """TCP proxy with programmable connection faults.
 
@@ -84,6 +230,7 @@ class FlakyProxy:
         self._refuse = False  # close every connection immediately
         self._delay_s = 0.0  # added latency before forwarding starts
         self._cut_after = 0  # RST after N response bytes (0 = off)
+        self._black_hole = False  # accept + read, never answer
         self._conns: list = []
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -119,12 +266,23 @@ class FlakyProxy:
         with self._lock:
             self._cut_after = n_bytes
 
+    def black_hole(self) -> None:
+        """Accept every connection and read its requests, but never
+        forward or answer — the nastiest master failure mode: alive at
+        the TCP layer, dead at the protocol layer. A client whose recv
+        is unbounded hangs here FOREVER regardless of its retry
+        deadline (the master_client settimeout(None) bug this fault
+        exists to pin)."""
+        with self._lock:
+            self._black_hole = True
+
     def heal(self) -> None:
         with self._lock:
             self._refuse = False
             self._reset_budget = 0
             self._delay_s = 0.0
             self._cut_after = 0
+            self._black_hole = False
 
     def cut_existing(self) -> None:
         """RST every currently-open proxied connection (network
@@ -148,8 +306,16 @@ class FlakyProxy:
                     self._reset_budget -= 1
                 delay_s = self._delay_s
                 cut_after = self._cut_after
+                black_hole = self._black_hole
             if refuse:
                 _rst_close(client)
+                continue
+            if black_hole:
+                with self._lock:
+                    self._conns.append(client)
+                threading.Thread(
+                    target=_swallow, args=(client,), daemon=True
+                ).start()
                 continue
             threading.Thread(
                 target=self._serve,
@@ -220,6 +386,20 @@ def _rst_close(s: socket.socket) -> None:
         s.close()
     except OSError:
         pass
+
+
+def _swallow(s: socket.socket) -> None:
+    """black_hole service: read and discard until the peer gives up."""
+    try:
+        while s.recv(65536):
+            pass
+    except OSError:
+        pass
+    finally:
+        try:
+            s.close()
+        except OSError:
+            pass
 
 
 def _pump(src: socket.socket, dst: socket.socket,

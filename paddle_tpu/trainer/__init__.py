@@ -9,4 +9,12 @@ from paddle_tpu.trainer.async_checkpoint import (  # noqa: F401
     AsyncCheckpointer,
     AsyncCheckpointError,
 )
+from paddle_tpu.trainer.watchdog import (  # noqa: F401
+    EXIT_PREEMPTED,
+    Preempted,
+    Watchdog,
+    WatchdogAbort,
+    WatchdogConfig,
+    WatchdogReport,
+)
 from paddle_tpu.trainer.trainer import SGD  # noqa: F401

@@ -29,11 +29,14 @@ the loader falls back to the newest older pass that verifies.
 
 Failure contract: the background writer never lets an exception vanish
 in a daemon thread. The first error is latched; the next `save()` or
-`wait()` re-raises it as `AsyncCheckpointError`.
+`wait()` re-raises it as `AsyncCheckpointError`. Normal interpreter
+exit drains every live writer via an atexit hook, so an
+enqueued-but-unwritten pass survives a caller that forgets wait().
 """
 
 from __future__ import annotations
 
+import atexit
 import hashlib
 import json
 import os
@@ -41,6 +44,7 @@ import queue
 import re
 import shutil
 import threading
+import weakref
 
 import jax
 import numpy as np
@@ -54,6 +58,28 @@ _PASS_RE = re.compile(r"^pass-(\d{5})$")
 
 class AsyncCheckpointError(RuntimeError):
     """A background checkpoint write failed (re-raised on the caller)."""
+
+
+# Every live AsyncCheckpointer; drained at interpreter exit so a pass
+# that was enqueued but not yet written cannot be dropped by a normal
+# `exit()` (daemon writer threads die mid-write at teardown). atexit
+# runs while daemon threads are still scheduled, so q.join() drains.
+# SIGKILL still loses the queue — that is what the manifest/fallback
+# protocol is for.
+_LIVE_CHECKPOINTERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _flush_live_checkpointers() -> None:
+    import logging
+
+    for cp in list(_LIVE_CHECKPOINTERS):
+        try:
+            cp.close()
+        except Exception:
+            logging.getLogger("paddle_tpu.trainer").exception(
+                "async checkpoint flush at interpreter exit failed"
+            )
 
 
 def _pass_dir(save_dir: str, pass_id: int) -> str:
@@ -424,6 +450,7 @@ class AsyncCheckpointer:
         )
         self._thread.start()
         self._closed = False
+        _LIVE_CHECKPOINTERS.add(self)
 
     # ---- error contract ----
     @property
@@ -520,6 +547,7 @@ class AsyncCheckpointer:
         """Drain, stop the writer thread, surface any error."""
         if self._closed:
             return
+        _LIVE_CHECKPOINTERS.discard(self)
         self._q.join()
         self._closed = True
         self._q.put(None)

@@ -26,6 +26,7 @@ from paddle_tpu.optimizers import create_optimizer
 from paddle_tpu.parallel.dp import TrainStep
 from paddle_tpu.trainer import async_checkpoint as actp
 from paddle_tpu.trainer import checkpoint as ckpt
+from paddle_tpu.trainer import watchdog as wdg
 from paddle_tpu.trainer.events import (
     BeginIteration,
     BeginPass,
@@ -37,6 +38,19 @@ from paddle_tpu.trainer.events import (
 log = logging.getLogger("paddle_tpu.trainer")
 
 _BASE_PRNG_IMPL = None  # captured at first SGD init (process default)
+
+
+class _NullPreemptionGuard:
+    """Stand-in when there is no save_dir to flush to: SIGTERM keeps
+    its process-default meaning."""
+
+    preempted = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
 
 
 class SGD:
@@ -55,7 +69,21 @@ class SGD:
         evaluators: Optional[list] = None,
         seed: int = 0,
         params: Optional[dict] = None,
+        watchdog=None,
     ):
+        """`watchdog`: None = follow the `watchdog` flag (default on);
+        False disables; True or a `wdg.WatchdogConfig` enables with
+        the given knobs. Enabled, the train step skips non-finite
+        updates on device and `train` runs the escalation ladder
+        (skip -> LR backoff -> rollback -> abort) plus SIGTERM-safe
+        preemption (trainer/watchdog.py)."""
+        if watchdog is None:
+            watchdog = bool(_flags.get_flag("watchdog"))
+        if watchdog is True:
+            watchdog = wdg.WatchdogConfig()
+        self.watchdog_conf = watchdog or None
+        self.last_watchdog_report: Optional[wdg.WatchdogReport] = None
+        self._resume_skip_batches = 0
         self.net = Network(model_conf)
         self.opt_conf = opt_conf
         self.opt = create_optimizer(opt_conf, self.net.param_confs)
@@ -91,7 +119,8 @@ class SGD:
             if k in c
         }
         self.step_fn = TrainStep(
-            self.net, self.opt, mesh=mesh, keep_outputs=eval_layers
+            self.net, self.opt, mesh=mesh, keep_outputs=eval_layers,
+            watchdog=self.watchdog_conf is not None,
         )
         self.params, self.opt_state, self.state = self.step_fn.place(
             self.params, self.opt_state, self.state
@@ -144,19 +173,34 @@ class SGD:
         """Run ONE jitted train step on an already-fed Arg dict and
         return the cost — the TrainerInternal::trainOneBatch unit
         (TrainerInternal.cpp:66), used by the --job=time harness."""
+        cost, _finite, _outs = self.run_step(feed)
+        return cost
+
+    def run_step(self, feed, lr_scale: float = 1.0) -> tuple:
+        """One step on an already-fed Arg dict; returns
+        (cost, finite, outs). The public stepping unit for external
+        loops (paddle.v2's trainer drives this). In watchdog mode the
+        step returns the 2-float health vector [loss, all_finite] —
+        ONE device->host fetch carries both, so the finiteness verdict
+        costs no extra transfer over the loss fetch the loop always
+        made — and a non-finite batch's update was already skipped on
+        device."""
         rng = _rng.split_for_step(self.step_key, self.global_step)
         (
             self.params,
             self.opt_state,
             self.state,
             loss,
-            _,
+            outs,
         ) = self.step_fn(
             self.params, self.opt_state, self.state, feed,
-            self.global_step, rng,
+            self.global_step, rng, lr_scale=lr_scale,
         )
         self.global_step += 1
-        return float(loss)
+        if self.step_fn.watchdog:
+            health = np.asarray(loss)  # the single host fetch
+            return float(health[0]), bool(health[1]), outs
+        return float(loss), True, outs
 
     def train(
         self,
@@ -168,6 +212,7 @@ class SGD:
         save_dir: Optional[str] = None,
         start_pass: int = 0,
         checkpoint_mode: Optional[str] = None,
+        skip_batches: Optional[int] = None,
     ):
         """reader yields raw batches (lists of sample tuples); feeder
         converts them to Arg dicts.
@@ -175,7 +220,12 @@ class SGD:
         checkpoint_mode: None = the `checkpoint_mode` flag; "sync" =
         blocking per-pass save_pass; "async" = overlapped sharded
         writes (trainer/async_checkpoint.py) where only the
-        device->host snapshot blocks the loop."""
+        device->host snapshot blocks the loop.
+
+        skip_batches: batches of `start_pass` to skip before training
+        resumes — the mid-pass preemption-resume offset. None = use the
+        offset the last `resume()` recorded from a mid-pass checkpoint
+        (0 when the checkpoint was an ordinary end-of-pass save)."""
         event_handler = event_handler or (lambda e: None)
         log_period = _flags.get_flag("log_period")
         ckpt_mode = checkpoint_mode or _flags.get_flag("checkpoint_mode")
@@ -183,36 +233,50 @@ class SGD:
             raise ValueError(f"unknown checkpoint_mode {ckpt_mode!r}")
         if save_dir and ckpt_mode == "async":
             self._ensure_async_ckpt(save_dir)
+        if skip_batches is None:
+            skip_batches = self._resume_skip_batches
+        self._resume_skip_batches = 0
+        wd = (
+            wdg.Watchdog(self.watchdog_conf)
+            if self.watchdog_conf is not None else None
+        )
+        if wd is not None:
+            self.last_watchdog_report = wd.report
+        # SIGTERM -> flag; checked at batch boundaries only, so the
+        # in-flight jitted step always completes before the flush.
+        # Installed only when there is somewhere to flush to.
+        guard = (
+            wdg.PreemptionGuard() if save_dir
+            else _NullPreemptionGuard()
+        )
         ok = False
         try:
+          with guard:
             for pass_id in range(start_pass, num_passes):
                 event_handler(BeginPass(pass_id))
                 evals = self._make_evaluators()
                 costs = []
                 for batch_id, raw in enumerate(reader()):
+                    if pass_id == start_pass and batch_id < skip_batches:
+                        # already trained before the preemption (their
+                        # work lives in the flushed checkpoint) — the
+                        # deterministic reader replays them, the loop
+                        # drops them
+                        continue
                     event_handler(BeginIteration(pass_id, batch_id))
                     feed = feeder(raw)
-                    rng = _rng.split_for_step(self.step_key, self.global_step)
                     with GLOBAL_STATS.timer("train_step"):
-                        (
-                            self.params,
-                            self.opt_state,
-                            self.state,
-                            loss,
-                            outs,
-                        ) = self.step_fn(
-                            self.params,
-                            self.opt_state,
-                            self.state,
-                            feed,
-                            self.global_step,
-                            rng,
+                        cost, finite, outs = self.run_step(
+                            feed, wd.lr_scale() if wd else 1.0
                         )
-                    cost = float(loss)
-                    costs.append(cost)
-                    for ev in evals:
-                        ev.add_batch(outs, feed)
-                    self.global_step += 1
+                    if finite:
+                        costs.append(cost)
+                        for ev in evals:
+                            ev.add_batch(outs, feed)
+                    if wd is not None:
+                        self._watchdog_act(
+                            wd, cost, finite, save_dir, ckpt_mode,
+                        )
                     results = (
                         {ev.name: ev.result() for ev in evals}
                         if (batch_id + 1) % log_period == 0
@@ -226,7 +290,8 @@ class SGD:
                             "pass %d batch %d cost %.5f %s",
                             pass_id,
                             batch_id,
-                            float(np.mean(costs[-log_period:])),
+                            float(np.mean(costs[-log_period:]))
+                            if costs else float("nan"),
                             results,
                         )
                     stats_period = _flags.get_flag(
@@ -234,6 +299,17 @@ class SGD:
                     )
                     if stats_period and (batch_id + 1) % stats_period == 0:
                         self._log_parameter_stats(pass_id, batch_id)
+                    if guard.preempted:
+                        # the in-flight batch completed and is counted
+                        # in batch_id+1: the flush loses zero
+                        # completed-batch work
+                        self._preempt_flush(
+                            save_dir, ckpt_mode, pass_id, batch_id + 1
+                        )
+                        raise wdg.Preempted(
+                            pass_id, batch_id + 1, save_dir
+                        )
+                skip_batches = 0
                 results = {ev.name: ev.result() for ev in evals}
                 if test_reader is not None:
                     tr = self.test(test_reader, feeder)
@@ -262,6 +338,11 @@ class SGD:
                                 meta={"global_step": self.global_step},
                                 save_only_one=_flags.get_flag("save_only_one"),
                             )
+                    if wd is not None:
+                        # candidate only: promoted to the rollback
+                        # target after `good_batches` healthy batches
+                        # (watchdog.py "good checkpoint" rule)
+                        wd.on_checkpoint(pass_id)
                 # per-pass timer report (the WITH_TIMER StatSet dump,
                 # TrainerInternal.cpp:177 area / utils/Stat.h:189) —
                 # reset after logging so each pass reports only itself
@@ -286,6 +367,78 @@ class SGD:
                             "async checkpoint drain failed while "
                             "handling a training error"
                         )
+
+    def _watchdog_act(self, wd, cost, finite, save_dir, ckpt_mode):
+        """Run the ladder on one batch's (cost, finite) verdict;
+        perform the rollback here (the trainer owns params/resume)."""
+        action = wd.observe(cost, finite, self.global_step - 1)
+        if action == wdg.ROLLBACK:
+            target = wd.good_pass
+            with GLOBAL_STATS.timer("watchdog_rollback"):
+                if ckpt_mode == "async" and getattr(
+                    self, "_async_ckpt", None
+                ) is not None:
+                    # commit in-flight writes (and surface write
+                    # errors) before reading manifests back
+                    self._async_ckpt.wait()
+                try:
+                    self.resume(save_dir, pass_id=target)
+                except (FileNotFoundError, ValueError, OSError) as e:
+                    # the promoted pass can be rotated away
+                    # (save_only_one / keep_last) before a rollback
+                    # needs it: out of rungs — abort with the report,
+                    # never a raw load traceback
+                    wd.report.aborted = True
+                    wd.report.abort_reason = (
+                        f"rollback target pass {target} unloadable "
+                        f"({type(e).__name__}: {e}) — rotated away?"
+                    )
+                    wd.report.events.append(wdg.WatchdogEvent(
+                        "abort", self.global_step,
+                        {"reason": wd.report.abort_reason},
+                    ))
+                    log.error("watchdog abort: %s",
+                              wd.report.abort_reason)
+                    raise wdg.WatchdogAbort(wd.report) from e
+            log.warning(
+                "watchdog: rolled back to checkpoint pass %d "
+                "(global_step %d)", target, self.global_step,
+            )
+            wd.on_rollback(target, self.global_step)
+        elif action == wdg.ABORT:
+            log.error("watchdog abort: %s", wd.report.abort_reason)
+            raise wdg.WatchdogAbort(wd.report)
+
+    def _preempt_flush(self, save_dir, ckpt_mode, pass_id,
+                       batches_done):
+        """SIGTERM landed: flush a mid-pass checkpoint covering every
+        COMPLETED batch, so the respawned process resumes at
+        `batches_done` with zero lost work."""
+        meta = {
+            "global_step": self.global_step,
+            "mid_pass": True,
+            "batch_in_pass": batches_done,
+        }
+        with GLOBAL_STATS.timer("preempt_flush"):
+            if ckpt_mode == "async":
+                self._ensure_async_ckpt(save_dir)
+                self._async_ckpt.save(
+                    pass_id, self.params, self.opt_state, self.state,
+                    meta=meta,
+                )
+                self._async_ckpt.wait()
+            else:
+                ckpt.save_pass(
+                    save_dir, pass_id,
+                    jax.device_get(self.params),
+                    jax.device_get(self.opt_state),
+                    jax.device_get(self.state),
+                    meta=meta,
+                )
+        log.warning(
+            "preempted: flushed pass %d at batch %d to %s; exiting "
+            "for resume", pass_id, batches_done, save_dir,
+        )
 
     def test(self, reader: Callable, feeder: Callable) -> dict:
         """Evaluation pass (reference: trainer/Tester.h)."""
@@ -320,7 +473,14 @@ class SGD:
         """Load a checkpoint; returns the next pass id (start_pass
         semantics of trainer/ParamUtil.h). Reads whichever format is
         newest and COMPLETE: async sharded passes (manifest-verified,
-        torn shards skipped) or synchronous save_pass directories."""
+        torn shards skipped) or synchronous save_pass directories.
+
+        A MID-PASS checkpoint (the preemption flush) returns its own
+        pass id — the pass is unfinished — and records the number of
+        already-trained batches; the next `train()` call skips exactly
+        that many batches of its first pass, so a SIGTERM/resume cycle
+        replays nothing and loses nothing."""
+        self._resume_skip_batches = 0
         if pass_id >= 0:
             use_async = (
                 pass_id in actp.list_passes(save_dir)
@@ -356,6 +516,11 @@ class SGD:
             self.params, self.opt_state, self.state
         )
         self.global_step = meta.get("global_step", 0)
+        if meta.get("mid_pass"):
+            self._resume_skip_batches = int(
+                meta.get("batch_in_pass", 0)
+            )
+            return meta["pass_id"]
         return meta["pass_id"] + 1
 
 

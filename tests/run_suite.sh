@@ -2,10 +2,16 @@
 # Parallel test-suite runner: shards test files across N pytest
 # processes (default 3) so the full gate finishes in ~1/N the wall time
 # (the single-process suite is ~8 min; this brings it under 5).
+#
+# The fault-injection tier (`-m faults`: SIGKILL/SIGTERM workers,
+# FlakyProxy, corruption) runs as its OWN shard under a hard timeout:
+# a hung fault test (a worker that survived its kill, a proxy that
+# never released a socket) must fail the gate, not wedge it.
 # Usage: tests/run_suite.sh [N]
 set -u
 cd "$(dirname "$0")/.."
 N="${1:-3}"
+FAULTS_TIMEOUT="${FAULTS_TIMEOUT:-900}"
 mapfile -t FILES < <(ls tests/test_*.py)
 
 pids=()
@@ -16,7 +22,8 @@ for ((i = 0; i < N; i++)); do
   done
   JAX_PLATFORMS=cpu \
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
-    python -m pytest "${shard[@]}" -q >"/tmp/suite_shard_$i.log" 2>&1 &
+    python -m pytest "${shard[@]}" -q -m 'not faults' \
+    >"/tmp/suite_shard_$i.log" 2>&1 &
   pids+=($!)
 done
 
@@ -25,4 +32,17 @@ for ((i = 0; i < N; i++)); do
   wait "${pids[$i]}" || rc=1
   tail -2 "/tmp/suite_shard_$i.log" | sed "s/^/[shard $i] /"
 done
+
+# fault-injection shard: every faults-marked test, one process,
+# timeout-guarded (timeout -k: SIGKILL if SIGTERM is ignored — these
+# tests spawn processes that are SUPPOSED to survive SIGTERM). Runs
+# AFTER the regular shards drain: the tier's SIGTERM windows and
+# loss-curve comparisons are timing-sensitive, and racing them
+# against N parallel pytest processes makes them flaky.
+JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  timeout -k 15 "$FAULTS_TIMEOUT" \
+  python -m pytest tests/ -q -m faults \
+  >"/tmp/suite_shard_faults.log" 2>&1 || rc=1
+tail -2 /tmp/suite_shard_faults.log | sed "s/^/[shard faults] /"
 exit $rc

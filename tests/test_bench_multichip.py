@@ -9,6 +9,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -97,6 +99,43 @@ def test_checkpoint_overhead_row_async_beats_sync(tmp_path):
     # and the async writer really committed manifest-complete passes
     # (keep_last=2 rotation: exactly the newest 2 survive the run)
     assert row["async_committed_passes"] == 2, row
+
+
+@pytest.mark.faults
+def test_preempt_recovery_row_exactly_once_and_recorded(tmp_path):
+    """The permanent recovery row (ISSUE 9): a SIGTERMed trainer must
+    lose and retrain ZERO batches (the mid-pass flush + exact-batch
+    resume contract), an injected NaN must be detected within one
+    batch and rolled back, and the row must land in the full-row
+    artifact — elasticity measured like throughput."""
+    env = _mc_env(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "bench_multichip.py", "preempt_recovery"],
+        capture_output=True, text=True, cwd=REPO, timeout=580,
+        env=env,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    by_name = {ln["metric"]: ln for ln in lines}
+    n = by_name["mc_config"]["devices"]
+    row = by_name[f"mc_preempt_recovery_dp{n}"]
+    assert row.get("error") is None, row
+    # the lossless-preemption contract: every global step trained
+    # exactly once across SIGTERM + respawn
+    assert row["sigterm_exit_code"] == 75
+    assert row["sigterm_batches_lost"] == 0, row
+    assert row["sigterm_batches_retrained"] == 0, row
+    assert row["value"] > 0 and row["sigterm_flush_s"] > 0
+    # the divergence contract: detection within one batch, exactly
+    # one rollback, bounded progress discarded
+    assert row["nan_detect_batches"] == 1, row
+    assert row["nan_rollbacks"] == 1, row
+    assert 0 <= row["nan_batches_lost"] <= row["batches_per_pass"], row
+    # and the row reached the full-row artifact (ROADMAP 5b)
+    full = [json.loads(ln)
+            for ln in open(env["BENCH_FULL_RECORD"]).read().splitlines()]
+    assert f"mc_preempt_recovery_dp{n}" in {ln["metric"] for ln in full}
 
 
 def test_multichip_rows_cover_reference_matrix():

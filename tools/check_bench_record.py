@@ -15,6 +15,10 @@ both run by `tests/test_check_bench_record.py`:
   the same run, assert the multiset of stdout row ids ("metric" keys)
   is contained in the artifact. A stdout row missing from the record
   is exactly the regression 5b forbids.
+- the static pass also asserts the PERMANENT elasticity rows
+  (`mc_checkpoint_overhead`, `mc_preempt_recovery`) are still
+  registered in bench_multichip.py — deleting a permanent row is a
+  perf-record regression, not a cleanup.
 
 Usage:
     python tools/check_bench_record.py static [repo_dir]
@@ -32,6 +36,10 @@ import sys
 from collections import Counter
 
 BENCH_FILES = ("bench.py", "bench_multichip.py")
+
+# permanent rows the multichip sweep must keep registering (ROADMAP 4 /
+# ISSUE 9: elasticity is measured, not assumed)
+REQUIRED_MC_ROWS = ("mc_checkpoint_overhead", "mc_preempt_recovery")
 
 
 def _is_json_dumps(node: ast.AST) -> bool:
@@ -87,6 +95,17 @@ def check_static(repo_dir: str) -> list:
             "bench_multichip.py: does not import emit from bench — "
             "its rows cannot reach the full-row artifact"
         )
+    # the permanent elasticity rows must still be registered (string
+    # literals in the row-name f-strings/constants)
+    with open(mc) as f:
+        mc_src = f.read()
+    for row in REQUIRED_MC_ROWS:
+        if row not in mc_src:
+            violations.append(
+                f"bench_multichip.py: permanent row {row!r} is no "
+                f"longer registered — the elasticity record would "
+                f"silently stop being captured"
+            )
     return violations
 
 
