@@ -432,6 +432,7 @@ def _bench_preempt_recovery(n_dev, synthetic):
     import tempfile
 
     from paddle_tpu.testing_faults import (
+        read_metrics_records,
         read_worker_records,
         start_preemptible_trainer,
     )
@@ -496,10 +497,11 @@ def _bench_preempt_recovery(n_dev, synthetic):
         shutil.rmtree(work, ignore_errors=True)
         os.makedirs(work, exist_ok=True)
         nan_at = 2 * batches + 4  # mid-pass 2: passes 0-1 checkpointed
+        metrics_file = os.path.join(work, "metrics.jsonl")
         p3 = start_preemptible_trainer(
             repo, save, out_file, NUM_PASSES=num_passes,
             BATCHES=batches, NAN_AT=nan_at, SKIP_BUDGET=0,
-            GOOD_BATCHES=2,
+            GOOD_BATCHES=2, METRICS_FILE=metrics_file,
         )
         t2 = time.monotonic()
         rc3 = p3.wait(timeout=600)
@@ -510,11 +512,21 @@ def _bench_preempt_recovery(n_dev, synthetic):
             )
         report = next(ln["report"] for ln in _lines()
                       if "report" in ln)
-        skips = [e for e in report["events"] if e["kind"] == "skip"]
-        rollbacks = [e for e in report["events"]
-                     if e["kind"] == "rollback"]
+        # the watchdog's structured series on the obs METRICS stream
+        # (ISSUE 10) is the measurement source now — the report stays
+        # as a cross-check that stream and report cannot disagree
+        wd_events = read_metrics_records(metrics_file, kind="watchdog")
+        skips = [e for e in wd_events if e["event"] == "skip"]
+        rollbacks = [e for e in wd_events if e["event"] == "rollback"]
         if not rollbacks:
-            raise RuntimeError(f"no rollback in report: {report}")
+            raise RuntimeError(
+                f"no rollback on metrics stream: {wd_events}"
+            )
+        if len(rollbacks) != report["rollbacks"]:
+            raise RuntimeError(
+                f"metrics stream ({len(rollbacks)} rollbacks) "
+                f"disagrees with report ({report['rollbacks']})"
+            )
         # detection latency, MEASURED from the event stream: the skip
         # event's global_step minus the injected batch's step, plus 1
         # (the contract is "within 1 batch" — fires ON the poisoned
@@ -525,6 +537,10 @@ def _bench_preempt_recovery(n_dev, synthetic):
         # progress discarded = steps from the restored checkpoint to
         # the fault (they retrain after rollback)
         batches_lost_nan = nan_at - rollbacks[0]["global_step"]
+        # per-pass step-timeline records from the same stream give
+        # this row the attribution triple every permanent row carries
+        timelines = read_metrics_records(metrics_file, kind="timeline")
+        tl = timelines[-1] if timelines else {}
     finally:
         shutil.rmtree(work, ignore_errors=True)
 
@@ -542,6 +558,9 @@ def _bench_preempt_recovery(n_dev, synthetic):
         "devices": n_dev,
         "passes": num_passes,
         "batches_per_pass": batches,
+        "data_wait_frac": tl.get("data_wait_frac", 0.0),
+        "host_overhead_frac": tl.get("host_overhead_frac", 0.0),
+        "device_frac": tl.get("device_frac", 0.0),
     }
     if synthetic:
         out["synthetic"] = True

@@ -19,9 +19,10 @@ A brand-new framework with the capabilities of 2017-era PaddlePaddle
 
 __version__ = "0.1.0"
 
+# Eager imports stay jax-free so `import paddle_tpu` works in serving
+# front ends / data workers without the device runtime (obs lint);
+# Arg/get_mesh/set_mesh resolve lazily below.
 from paddle_tpu.core import config, registry  # noqa: F401
-from paddle_tpu.core.arg import Arg  # noqa: F401
-from paddle_tpu.core.mesh import get_mesh, set_mesh  # noqa: F401
 
 
 def init(**flags):
@@ -53,6 +54,14 @@ _LAZY = {
 def __getattr__(name):
     """Lazy submodule access (keeps `import paddle_tpu` light):
     paddle_tpu.dsl, paddle_tpu.dataset.mnist, paddle_tpu.infer, ..."""
+    if name == "Arg":
+        from paddle_tpu.core.arg import Arg
+
+        return Arg
+    if name in ("get_mesh", "set_mesh"):
+        from paddle_tpu.core import mesh
+
+        return getattr(mesh, name)
     if name == "Network":
         from paddle_tpu.network import Network
 

@@ -420,6 +420,67 @@ def cmd_serve(args):
     return 0
 
 
+def cmd_metrics(args):
+    """One-shot telemetry dump (ISSUE 10). Without arguments, prints
+    the CURRENT process's registry snapshot (text or --json) — mostly
+    useful from code or a REPL. With --stream FILE, summarizes a JSONL
+    event stream another process wrote (enable_event_stream /
+    METRICS_FILE): event counts by kind, watchdog rungs, the last
+    per-pass timeline record. Deliberately jax-free: inspecting
+    telemetry must not initialize a device runtime."""
+    from paddle_tpu.obs import metrics as om
+
+    if args.stream:
+        from paddle_tpu.testing_faults import read_metrics_records
+
+        recs = read_metrics_records(args.stream)
+        kinds = {}
+        for r in recs:
+            kinds[r.get("kind", "?")] = kinds.get(r.get("kind", "?"), 0) + 1
+        wd = {}
+        for r in recs:
+            if r.get("kind") == "watchdog":
+                wd[r["event"]] = wd.get(r["event"], 0) + 1
+        timelines = [r for r in recs if r.get("kind") == "timeline"]
+        summary = {
+            "stream": args.stream,
+            "events": len(recs),
+            "by_kind": kinds,
+            "watchdog_events": wd,
+            "last_timeline": timelines[-1] if timelines else None,
+        }
+        if args.json:
+            print(json.dumps(summary, indent=2))
+        else:
+            print(f"event stream {args.stream}: {len(recs)} events")
+            for k, n in sorted(kinds.items()):
+                print(f"  {k:20s} {n}")
+            if wd:
+                print("watchdog ladder:")
+                for k, n in sorted(wd.items()):
+                    print(f"  {k:20s} {n}")
+            if timelines:
+                t = timelines[-1]
+                print(
+                    "last timeline: pass %s step %s  "
+                    "data_wait=%.1f%% host=%.1f%% device=%.1f%% "
+                    "ckpt=%.1f%%" % (
+                        t.get("pass_id"), t.get("global_step"),
+                        100 * t.get("data_wait_frac", 0),
+                        100 * t.get("host_overhead_frac", 0),
+                        100 * t.get("device_frac", 0),
+                        100 * t.get("checkpoint_stall_frac", 0),
+                    )
+                )
+        return 0
+    reg = om.get_registry()
+    if args.json:
+        print(json.dumps(reg.snapshot(), indent=2))
+    else:
+        print(reg.render_text())
+    return 0
+
+
 def cmd_make_diagram(args):
     """Emit a graphviz .dot of the layer graph (the reference's
     `paddle make_diagram`, scripts/submit_local.sh.in:3-13)."""
@@ -524,6 +585,18 @@ def main(argv=None):
                          "LISTENING <port>)")
     sp.add_argument("--drain_timeout", type=float, default=30.0)
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser(
+        "metrics",
+        help="one-shot telemetry snapshot (process registry, or "
+             "--stream FILE to summarize a JSONL event stream)",
+    )
+    sp.add_argument("--json", action="store_true",
+                    help="JSON instead of text")
+    sp.add_argument("--stream", default="",
+                    help="summarize this JSONL event-stream file "
+                         "instead of the in-process registry")
+    sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("make_diagram", help="emit graphviz dot of a config")
     sp.add_argument("--config", required=True)

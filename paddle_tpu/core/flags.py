@@ -40,6 +40,11 @@ _DEFAULTS: dict[str, Any] = {
     # (trainer/async_checkpoint.py)
     "checkpoint_mode": "sync",
     "start_pass": 0,
+    # per-step timeline attribution (obs/timeline.py): fence the
+    # device with block_until_ready every N steps so device_step is
+    # measured end-to-end while steady-state dispatch stays async.
+    # 0 = never fence (fetches the loop makes anyway still count).
+    "timeline_sample_period": 16,
     # data
     "prefetch_depth": 2,
     # kernels: None = auto (fused Pallas cells on TPU, lax.scan elsewhere)

@@ -1,10 +1,17 @@
-"""Timers and stats.
+"""Timers and stats — now a VIEW over the unified metrics registry.
 
-Capability match for the reference's Stat/StatSet + REGISTER_TIMER macros
-(paddle/utils/Stat.h:63,114,244) and per-layer timing in
-NeuralNetwork.cpp:248. On TPU, intra-step timing belongs to the XLA
-profiler; these host-side timers measure whole steps / phases and feed
-the per-pass report the trainer logs (TrainerInternal.cpp:177 area).
+Capability match for the reference's Stat/StatSet + REGISTER_TIMER
+macros (paddle/utils/Stat.h:63,114,244) and per-layer timing in
+NeuralNetwork.cpp:248. Since ISSUE 10 the actual timer state lives in
+`paddle_tpu.obs.metrics` (one registry histogram per timer, family
+name `stat.<set>.<timer>`): every `REGISTER_TIMER`-style measurement
+is simultaneously visible to the metrics snapshot / `metricz` /
+event-stream machinery, and this module keeps only the reference's
+*presentation* — the per-pass report text (TrainerInternal.cpp:177
+area) is byte-compatible with the pre-registry format.
+
+No duplicate timer plumbing: `StatInfo` holds no numbers of its own;
+total/count/max/min/avg all read through to the registry histogram.
 """
 
 from __future__ import annotations
@@ -13,49 +20,74 @@ import contextlib
 import threading
 import time
 
+from paddle_tpu.obs import metrics as _metrics
+
 
 class StatInfo:
-    __slots__ = ("total", "count", "max", "min")
+    """View over one registry histogram (seconds)."""
 
-    def __init__(self):
-        self.total = 0.0
-        self.count = 0
-        self.max = 0.0
-        self.min = float("inf")
+    __slots__ = ("_hist",)
+
+    def __init__(self, hist: _metrics.Histogram):
+        self._hist = hist
 
     def add(self, dt: float):
-        self.total += dt
-        self.count += 1
-        self.max = max(self.max, dt)
-        self.min = min(self.min, dt)
+        self._hist.observe(dt)
+
+    @property
+    def total(self) -> float:
+        return self._hist.sum()
+
+    @property
+    def count(self) -> int:
+        return self._hist.count()
+
+    @property
+    def max(self) -> float:
+        return self._hist.max()
+
+    @property
+    def min(self) -> float:
+        return self._hist.min()
 
     @property
     def avg(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        return self._hist.avg()
 
 
 class StatSet:
-    def __init__(self, name: str = "default"):
+    def __init__(self, name: str = "default", registry=None):
         self.name = name
-        self._stats: dict[str, StatInfo] = {}
+        self._reg = registry or _metrics.get_registry()
+        self._names: set = set()
         self._lock = threading.Lock()
+
+    @property
+    def _prefix(self) -> str:
+        return f"stat.{self.name}."
 
     def stat(self, name: str) -> StatInfo:
         with self._lock:
-            return self._stats.setdefault(name, StatInfo())
+            self._names.add(name)
+        return StatInfo(self._reg.histogram(self._prefix + name))
 
     @contextlib.contextmanager
     def timer(self, name: str):
+        stat = self.stat(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.stat(name).add(time.perf_counter() - t0)
+            stat.add(time.perf_counter() - t0)
 
     def report(self) -> str:
         lines = [f"=== StatSet[{self.name}] ==="]
-        for name in sorted(self._stats):
-            s = self._stats[name]
+        with self._lock:
+            names = sorted(self._names)
+        for name in names:
+            s = StatInfo(self._reg.histogram(self._prefix + name))
+            if not s.count:
+                continue  # reset since last use: nothing to report
             lines.append(
                 f"{name:40s} count={s.count:8d} total={s.total:10.4f}s "
                 f"avg={s.avg * 1e3:9.3f}ms max={s.max * 1e3:9.3f}ms"
@@ -63,8 +95,12 @@ class StatSet:
         return "\n".join(lines)
 
     def reset(self):
+        """Zero this set's registry series in place (held StatInfo
+        views keep working — they read through to the same
+        histograms)."""
+        self._reg.reset_prefix(self._prefix)
         with self._lock:
-            self._stats.clear()
+            self._names.clear()
 
 
 GLOBAL_STATS = StatSet("global")

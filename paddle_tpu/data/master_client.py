@@ -33,6 +33,8 @@ import struct
 import time
 from typing import Optional
 
+from paddle_tpu.obs import metrics as _obs
+
 _BACKOFF_BASE = 0.05
 _BACKOFF_CAP = 1.0
 _MAX_FRAME = 1 << 30  # >1GiB response length = garbage, not a frame
@@ -156,6 +158,7 @@ class MasterClient:
         if op == _OP_REQUEST_SAVE:
             (block_s,) = struct.unpack("<d", body[:8])
             min_timeout = max(min_timeout, block_s + 5.0)
+        reg = _obs.get_registry()
         while True:
             try:
                 remaining = deadline - time.monotonic()
@@ -163,11 +166,16 @@ class MasterClient:
                     op, body, timeout=max(remaining, min_timeout)
                 )
             except MasterProtocolError:
+                reg.counter("master_client.protocol_errors").inc()
                 raise  # alive-but-wrong peer: retrying hides the bug
             except (OSError, ConnectionError) as e:
                 self.close()
+                reg.counter("master_client.retries").inc(op=op)
                 now = time.monotonic()
                 if now >= deadline:
+                    reg.counter(
+                        "master_client.retry_timeouts"
+                    ).inc(op=op)
                     raise MasterRetryTimeout(
                         f"master at {self._host}:{self._port} "
                         f"unreachable for {now - start:.1f}s "
@@ -178,7 +186,9 @@ class MasterClient:
                 # full jitter: U(0, min(cap, base*2^attempt)), clipped
                 # to the remaining budget so the deadline is honored
                 ceil = min(_BACKOFF_CAP, _BACKOFF_BASE * (2 ** attempt))
-                time.sleep(min(random.uniform(0, ceil), deadline - now))
+                delay = min(random.uniform(0, ceil), deadline - now)
+                reg.counter("master_client.backoff_s").inc(delay)
+                time.sleep(delay)
                 attempt += 1
 
     def close(self):

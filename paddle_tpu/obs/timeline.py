@@ -1,0 +1,125 @@
+"""Per-step wall-time attribution for training hot loops.
+
+Splits every trained batch's wall time into the four places it can
+go, so an input-pipeline stall is a tracked number like MFU instead
+of a vibe:
+
+- **data_wait**        — blocking in the reader/feeder before the
+                         step could even be dispatched
+- **host_dispatch**    — Python + runtime time to *submit* the jitted
+                         step (async dispatch: this returns before
+                         the device finishes)
+- **device_step**      — time blocked waiting on device results (the
+                         loss fetch, plus a full `block_until_ready`
+                         fence every `sample_period` steps so the
+                         parameter-update tail is measured too while
+                         steady-state dispatch stays async)
+- **checkpoint_stall** — training-thread stalls inside checkpoint
+                         saves / preemption flushes
+
+The timeline is pure bookkeeping (no jax — the *trainer* owns the
+fencing; `fence_now()` only answers "is this a sampled step").
+Totals are mirrored into the process registry as counters under
+`<prefix>.`; `fractions()` yields the `data_wait_frac` /
+`host_overhead_frac` / `device_frac` fields the bench drivers attach
+to every permanent north-star row, and `emit_pass()` writes one
+structured `timeline` event per pass to the JSONL stream.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.obs import metrics as _metrics
+
+PARTS = ("data_wait", "host_dispatch", "device_step", "checkpoint_stall")
+
+
+class StepTimeline:
+    def __init__(self, sample_period: int = 16, prefix: str = "trainer",
+                 registry=None):
+        """`sample_period`: fence (block_until_ready) every Nth step;
+        0 disables fencing (device_step then measures only the result
+        fetches the loop makes anyway)."""
+        self.sample_period = int(sample_period)
+        self.prefix = prefix
+        self._reg = registry or _metrics.get_registry()
+        self._totals = {p: 0.0 for p in PARTS}
+        self._steps = 0
+        self._fenced = 0
+
+    # ---- accumulation (trainer-side) ----
+    def _add(self, part: str, dt: float) -> None:
+        self._totals[part] += dt
+        self._reg.counter(f"{self.prefix}.{part}_s").inc(dt)
+
+    def add_data_wait(self, dt: float) -> None:
+        self._add("data_wait", dt)
+
+    def add_dispatch(self, dt: float) -> None:
+        self._add("host_dispatch", dt)
+
+    def add_device(self, dt: float) -> None:
+        self._add("device_step", dt)
+
+    def add_checkpoint(self, dt: float) -> None:
+        self._add("checkpoint_stall", dt)
+
+    def step_done(self) -> None:
+        self._steps += 1
+        self._reg.counter(f"{self.prefix}.steps").inc()
+
+    def fence_now(self, step_index: int) -> bool:
+        """True on sampled steps — the trainer then blocks until the
+        whole step (parameter update included) has landed, so
+        device_step covers the tail the loss fetch alone would miss."""
+        if self.sample_period <= 0:
+            return False
+        fence = step_index % self.sample_period == 0
+        if fence:
+            self._fenced += 1
+        return fence
+
+    # ---- export ----
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    def totals(self) -> dict:
+        return dict(self._totals)
+
+    def fractions(self) -> dict:
+        """Shares of the MEASURED wall (the four parts' sum — loop
+        bookkeeping outside them is not attributed). All zero before
+        the first step."""
+        wall = sum(self._totals.values())
+        if wall <= 0.0:
+            return {
+                "data_wait_frac": 0.0,
+                "host_overhead_frac": 0.0,
+                "device_frac": 0.0,
+                "checkpoint_stall_frac": 0.0,
+            }
+        return {
+            "data_wait_frac": round(self._totals["data_wait"] / wall, 4),
+            "host_overhead_frac": round(
+                self._totals["host_dispatch"] / wall, 4
+            ),
+            "device_frac": round(self._totals["device_step"] / wall, 4),
+            "checkpoint_stall_frac": round(
+                self._totals["checkpoint_stall"] / wall, 4
+            ),
+        }
+
+    def emit_pass(self, pass_id: int, global_step: int) -> None:
+        """One `timeline` event on the JSONL stream per pass (no-op
+        without a stream) — the record `mc_preempt_recovery` and the
+        fault tests read back."""
+        self._reg.event(
+            "timeline",
+            pass_id=pass_id,
+            global_step=global_step,
+            steps=self._steps,
+            fenced_steps=self._fenced,
+            sample_period=self.sample_period,
+            **{f"{p}_s": round(self._totals[p], 6) for p in PARTS},
+            **self.fractions(),
+        )

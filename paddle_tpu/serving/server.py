@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from paddle_tpu.data.feeder import _bucket
+from paddle_tpu.obs import metrics as _obs
 
 
 class ServeRejected(Exception):
@@ -140,11 +141,15 @@ class PendingResult:
 
 class _Breaker:
     """Per-model circuit breaker: closed -> open after N consecutive
-    failures -> half-open probe after reset_s -> closed on success."""
+    failures -> half-open probe after reset_s -> closed on success.
+    State transitions are counted in the process registry
+    (`serving.breaker_opens{model=}` / `serving.dispatch_failures`)."""
 
-    def __init__(self, threshold: int, reset_s: float):
+    def __init__(self, threshold: int, reset_s: float,
+                 model: str = ""):
         self.threshold = threshold
         self.reset_s = reset_s
+        self.model = model
         self.failures = 0
         self.opened_at = None
         self.probing = False
@@ -176,8 +181,16 @@ class _Breaker:
             self.opened_at = None
         else:
             self.failures += 1
+            _obs.get_registry().counter(
+                "serving.dispatch_failures"
+            ).inc(model=self.model)
             if self.failures >= self.threshold:
+                was_open = self.opened_at is not None
                 self.opened_at = time.monotonic()
+                if not was_open:
+                    _obs.get_registry().counter(
+                        "serving.breaker_opens"
+                    ).inc(model=self.model)
 
 
 @dataclass
@@ -228,7 +241,8 @@ class InferenceServer:
             self._models[name] = _ModelEntry(
                 model=model,
                 breaker=_Breaker(self.config.breaker_threshold,
-                                 self.config.breaker_reset_s),
+                                 self.config.breaker_reset_s,
+                                 model=name),
             )
 
     def submit(self, model: str, ids, deadline_s: float = None,
@@ -239,46 +253,60 @@ class InferenceServer:
         import numpy as np
 
         cfg = self.config
-        with self._lock:
-            if self._draining or self._stopped:
-                self._stats["shed_shutdown"] += 1
-                raise ServeRejected("shutting_down")
-            entry = self._models.get(model)
-            if entry is None:
-                raise ServeRejected("unknown_model", model)
-            if hooks_name is not None:
-                named = getattr(entry.model, "named_hooks", None) or {}
-                hooks = named.get(hooks_name)
-                if hooks is None:
+        reg = _obs.get_registry()
+        # registry updates are published AFTER self._lock is released
+        # (same rule as the completion path): the lock is the admission
+        # hot spot, and the registry takes locks of its own
+        try:
+            with self._lock:
+                if self._draining or self._stopped:
+                    self._stats["shed_shutdown"] += 1
+                    raise ServeRejected("shutting_down")
+                entry = self._models.get(model)
+                if entry is None:
+                    raise ServeRejected("unknown_model", model)
+                if hooks_name is not None:
+                    named = getattr(entry.model, "named_hooks",
+                                    None) or {}
+                    hooks = named.get(hooks_name)
+                    if hooks is None:
+                        raise ServeRejected(
+                            "unknown_hook",
+                            f"model {model!r} has no hook "
+                            f"{hooks_name!r}",
+                        )
+                if not entry.breaker.admits():
+                    self._stats["shed_quarantined"] += 1
+                    raise ServeRejected("quarantined", model)
+                if len(self._queue) >= cfg.max_queue:
+                    self._stats["shed_overload"] += 1
                     raise ServeRejected(
-                        "unknown_hook",
-                        f"model {model!r} has no hook {hooks_name!r}",
+                        "overloaded", f"queue at bound {cfg.max_queue}"
                     )
-            if not entry.breaker.admits():
-                self._stats["shed_quarantined"] += 1
-                raise ServeRejected("quarantined", model)
-            if len(self._queue) >= cfg.max_queue:
-                self._stats["shed_overload"] += 1
-                raise ServeRejected(
-                    "overloaded", f"queue at bound {cfg.max_queue}"
+                ids = np.asarray(ids, np.int32).reshape(-1)
+                bucket = _bucket(max(len(ids), 1), cfg.buckets)
+                deadline = time.monotonic() + (
+                    deadline_s if deadline_s is not None
+                    else cfg.default_deadline_s
                 )
-            ids = np.asarray(ids, np.int32).reshape(-1)
-            bucket = _bucket(max(len(ids), 1), cfg.buckets)
-            deadline = time.monotonic() + (
-                deadline_s if deadline_s is not None
-                else cfg.default_deadline_s
-            )
-            hooks_key = (hooks_name or id(hooks)) if hooks is not None \
-                else None
-            req = PendingResult(model, ids, bucket, deadline, hooks,
-                                hooks_key)
-            self._queue.append(req)
-            self._stats["admitted"] += 1
-            self._stats["max_queue_depth"] = max(
-                self._stats["max_queue_depth"], len(self._queue)
-            )
-            self._work.notify()
-            return req
+                hooks_key = (hooks_name or id(hooks)) \
+                    if hooks is not None else None
+                req = PendingResult(model, ids, bucket, deadline,
+                                    hooks, hooks_key)
+                self._queue.append(req)
+                depth = len(self._queue)
+                self._stats["admitted"] += 1
+                self._stats["max_queue_depth"] = max(
+                    self._stats["max_queue_depth"], depth
+                )
+                self._work.notify()
+        except ServeRejected as e:
+            reg.counter("serving.shed").inc(reason=e.reason)
+            raise
+        reg.counter("serving.admitted").inc(model=model)
+        reg.gauge("serving.queue_depth").set(depth)
+        reg.gauge("serving.queue_depth_hwm").set_max(depth)
+        return req
 
     def stats(self) -> dict:
         with self._lock:
@@ -323,6 +351,7 @@ class InferenceServer:
         stat = "shed_shutdown" if reason == "shutting_down" \
             else f"shed_{reason}"
         self._stats[stat] = self._stats.get(stat, 0) + 1
+        _obs.get_registry().counter("serving.shed").inc(reason=reason)
         req._finish(exc=ServeRejected(reason))
 
     def _pop_batch_locked(self):
@@ -425,6 +454,9 @@ class InferenceServer:
                 if not self._queue and self._draining:
                     return
                 popped = self._pop_batch_locked()
+                _obs.get_registry().gauge("serving.queue_depth").set(
+                    len(self._queue)
+                )
                 if popped is None:
                     if self._queue:
                         # everything queued is parked behind a
@@ -505,6 +537,10 @@ class InferenceServer:
                         ))
             return
         dt = time.monotonic() - t0
+        # per-request latencies are collected under the lock but
+        # published to the registry AFTER it: submit() contends on
+        # self._lock, and the registry takes its own locks
+        telemetry = []
         with self._lock:
             self._stats["batches"] += 1
             for name, (en, reqs) in groups.items():
@@ -518,6 +554,8 @@ class InferenceServer:
                      reqs[0].hooks_key is not None)
                 )
                 rows = results[name]
+                lats = []
+                waits = []
                 for i, r in enumerate(reqs):
                     out = dict(rows[i])
                     out.setdefault("path", "host" if host else "jit")
@@ -525,3 +563,27 @@ class InferenceServer:
                     self._stats["completed"] += 1
                     if host:
                         self._stats["completed_host"] += 1
+                    lats.append(r.t_done - r.t_submit)
+                    waits.append(max(t0 - r.t_submit, 0.0))
+                telemetry.append((name, lats, waits))
+        reg = _obs.get_registry()
+        reg.counter("serving.dispatch_s").inc(dt)
+        for name, lats, waits in telemetry:
+            # occupancy bookkeeping: one formed batch per group, its
+            # real (un-padded) request count alongside — mean
+            # occupancy = batch_requests / batches, read by the
+            # serve_loadtest bench row instead of recomputed there
+            reg.counter("serving.batches").inc(model=name)
+            reg.counter("serving.batch_requests").inc(
+                len(lats), model=name
+            )
+            # admitted-request time attribution: queued vs executing
+            # vs (residual) scheduling overhead
+            reg.counter("serving.request_latency_s").inc(sum(lats))
+            reg.counter("serving.request_queue_wait_s").inc(sum(waits))
+            reg.counter("serving.request_dispatch_s").inc(
+                dt * len(lats)
+            )
+            hist = reg.histogram("serving.admitted_latency_s")
+            for lat in lats:
+                hist.observe(lat, model=name)

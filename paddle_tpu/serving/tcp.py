@@ -4,6 +4,7 @@ Frame = 4-byte LE length + UTF-8 JSON. Request:
 
     {"model": str, "ids": [int, ...], "deadline_ms": int?,
      "hooks": str?}            # hooks = a model-registered hook name
+  | {"metricz": true}          # telemetry scrape (no inference)
 
 Response:
 
@@ -12,6 +13,14 @@ Response:
   | {"ok": false, "error": "overloaded"|"deadline"|"quarantined"|
      "shutting_down"|"unknown_model"|"unknown_hook"|"execution"|
      "bad_request"}
+  | {"ok": true, "metricz": <registry snapshot>, "stats": <server
+     stats>}                   # for a metricz request
+
+`metricz` serves the process-wide obs registry (queue depth +
+high-water mark, batch occupancy, shed/breaker counts, admitted-
+latency histograms — plus whatever else the process recorded) without
+touching the admission queue, so a scrape succeeds even when the
+server is overloaded and shedding inference traffic.
 
 Robustness contract (exercised by tests/test_serving_robustness.py
 with FlakyProxy RST/delay faults): a client that vanishes — RST
@@ -29,6 +38,7 @@ import socket
 import struct
 import threading
 
+from paddle_tpu.obs import metrics as _obs
 from paddle_tpu.serving.server import (
     InferenceServer,
     ServeError,
@@ -126,6 +136,14 @@ class ServingTCPServer:
                 pass
 
     def _handle(self, msg: dict) -> dict:
+        if isinstance(msg, dict) and msg.get("metricz"):
+            # telemetry scrape: answered outside the admission queue,
+            # so it works during overload/drain
+            return {
+                "ok": True,
+                "metricz": _obs.get_registry().snapshot(),
+                "stats": self.server.stats(),
+            }
         try:
             model = msg["model"]
             ids = msg["ids"]
@@ -206,13 +224,20 @@ class ServeClient:
 
     def call(self, model: str, ids, deadline_ms: int = None,
              hooks: str = None, timeout: float = None) -> dict:
-        if self._sock is None:
-            self._connect()
         msg = {"model": model, "ids": list(map(int, ids))}
         if deadline_ms is not None:
             msg["deadline_ms"] = int(deadline_ms)
         if hooks is not None:
             msg["hooks"] = hooks
+        return self._roundtrip(msg, timeout)
+
+    def metricz(self, timeout: float = None) -> dict:
+        """Scrape the server's registry snapshot + stats."""
+        return self._roundtrip({"metricz": True}, timeout)
+
+    def _roundtrip(self, msg: dict, timeout: float = None) -> dict:
+        if self._sock is None:
+            self._connect()
         try:
             # set every call: None restores blocking mode, so a
             # timeout passed once cannot leak into later calls

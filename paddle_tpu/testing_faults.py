@@ -85,6 +85,14 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
 
+# METRICS_FILE: attach the obs JSONL event stream — watchdog rungs,
+# preemption flushes, and per-pass timelines land there with
+# global_step stamps (read back via read_metrics_records)
+_mf = os.environ.get("METRICS_FILE")
+if _mf:
+    from paddle_tpu.obs import metrics as _om
+    _om.enable_event_stream(_mf, flush_interval_s=0.2)
+
 from paddle_tpu import dsl
 from paddle_tpu.core.config import OptimizationConf
 from paddle_tpu.data import reader as R
@@ -179,24 +187,49 @@ out.flush()
 """
 
 
+def _read_jsonl(path: str) -> list:
+    """One JSON dict per line; missing file = empty list. The single
+    parser behind both worker-record and metrics-stream readers."""
+    import json
+
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
 def read_worker_records(out_file: str) -> list:
     """Parse the preemptible worker's OUT_FILE (one JSON dict per
     line; schema documented on PREEMPTIBLE_TRAINER_SRC). Shared by
     the elastic-fault tests and the mc_preempt_recovery bench row so
     a record-format change breaks in one place, loudly."""
-    import json
+    return _read_jsonl(out_file)
 
-    if not os.path.exists(out_file):
-        return []
-    with open(out_file) as f:
-        return [json.loads(ln) for ln in f if ln.strip()]
+
+def read_metrics_records(path: str, kind: str = None,
+                         event: str = None) -> list:
+    """Metrics-stream variant of `read_worker_records`: parse the obs
+    JSONL event stream a worker wrote when METRICS_FILE was set
+    (records carry `kind` — "watchdog" / "timeline" / "preempt_flush"
+    — plus their payload; watchdog records name their ladder rung in
+    `event` and stamp `global_step`). Optional filters narrow by
+    `kind` and, for watchdog records, by `event`. Also reads the
+    rotated `<path>.1` generation first, so a stream that rotated
+    mid-run still replays in order."""
+    recs = _read_jsonl(path + ".1") + _read_jsonl(path)
+    if kind is not None:
+        recs = [r for r in recs if r.get("kind") == kind]
+    if event is not None:
+        recs = [r for r in recs if r.get("event") == event]
+    return recs
 
 
 def start_preemptible_trainer(repo: str, save_dir: str, out_file: str,
                               **env_overrides) -> subprocess.Popen:
     """Launch the preemptible SGD worker above. `env_overrides` set
     the worker knobs (NUM_PASSES, BATCHES, NAN_AT, SKIP_BUDGET,
-    GOOD_BATCHES) as strings."""
+    GOOD_BATCHES, METRICS_FILE — the obs event-stream path) as
+    strings."""
     env = dict(
         os.environ, REPO=repo, SAVE_DIR=save_dir, OUT_FILE=out_file,
         **{k: str(v) for k, v in env_overrides.items()},

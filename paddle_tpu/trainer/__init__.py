@@ -5,10 +5,6 @@ from paddle_tpu.trainer.events import (  # noqa: F401
     EndPass,
     TestResult,
 )
-from paddle_tpu.trainer.async_checkpoint import (  # noqa: F401
-    AsyncCheckpointer,
-    AsyncCheckpointError,
-)
 from paddle_tpu.trainer.watchdog import (  # noqa: F401
     EXIT_PREEMPTED,
     Preempted,
@@ -17,4 +13,22 @@ from paddle_tpu.trainer.watchdog import (  # noqa: F401
     WatchdogConfig,
     WatchdogReport,
 )
-from paddle_tpu.trainer.trainer import SGD  # noqa: F401
+
+# SGD / AsyncCheckpointer import jax; resolve them lazily so
+# `paddle_tpu.trainer.watchdog` stays importable without the device
+# runtime (serving front ends, data workers — see obs import lint).
+_LAZY = {
+    "SGD": "paddle_tpu.trainer.trainer",
+    "AsyncCheckpointer": "paddle_tpu.trainer.async_checkpoint",
+    "AsyncCheckpointError": "paddle_tpu.trainer.async_checkpoint",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        return getattr(importlib.import_module(_LAZY[name]), name)
+    raise AttributeError(
+        f"module 'paddle_tpu.trainer' has no attribute {name!r}"
+    )

@@ -10,6 +10,7 @@ forwardBackward + updater; the whole mesh runs it SPMD.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Callable, Optional
 
 import jax
@@ -20,6 +21,8 @@ from paddle_tpu.core import flags as _flags
 from paddle_tpu.core import rng as _rng
 from paddle_tpu.core.config import ModelConf, OptimizationConf
 from paddle_tpu.core.stat import GLOBAL_STATS
+from paddle_tpu.obs import metrics as _obs
+from paddle_tpu.obs.timeline import StepTimeline
 from paddle_tpu.evaluators import create_evaluator
 from paddle_tpu.network import Network
 from paddle_tpu.optimizers import create_optimizer
@@ -176,7 +179,8 @@ class SGD:
         cost, _finite, _outs = self.run_step(feed)
         return cost
 
-    def run_step(self, feed, lr_scale: float = 1.0) -> tuple:
+    def run_step(self, feed, lr_scale: float = 1.0,
+                 timeline=None) -> tuple:
         """One step on an already-fed Arg dict; returns
         (cost, finite, outs). The public stepping unit for external
         loops (paddle.v2's trainer drives this). In watchdog mode the
@@ -184,8 +188,16 @@ class SGD:
         ONE device->host fetch carries both, so the finiteness verdict
         costs no extra transfer over the loss fetch the loop always
         made — and a non-finite batch's update was already skipped on
-        device."""
+        device.
+
+        `timeline`: an obs.StepTimeline splitting this step's wall
+        time into host-dispatch (submitting the jitted program) vs
+        device-step (blocked on results). On the timeline's sampled
+        steps the params are fenced with block_until_ready so the
+        update tail is measured too; every other step stays async
+        beyond the loss fetch."""
         rng = _rng.split_for_step(self.step_key, self.global_step)
+        t0 = time.perf_counter() if timeline is not None else 0.0
         (
             self.params,
             self.opt_state,
@@ -197,10 +209,23 @@ class SGD:
             self.global_step, rng, lr_scale=lr_scale,
         )
         self.global_step += 1
+        if timeline is None:
+            if self.step_fn.watchdog:
+                health = np.asarray(loss)  # the single host fetch
+                return float(health[0]), bool(health[1]), outs
+            return float(loss), True, outs
+        t1 = time.perf_counter()
+        timeline.add_dispatch(t1 - t0)
         if self.step_fn.watchdog:
-            health = np.asarray(loss)  # the single host fetch
-            return float(health[0]), bool(health[1]), outs
-        return float(loss), True, outs
+            health = np.asarray(loss)
+            result = float(health[0]), bool(health[1]), outs
+        else:
+            result = float(loss), True, outs
+        if timeline.fence_now(self.global_step):
+            jax.block_until_ready(self.params)
+        timeline.add_device(time.perf_counter() - t1)
+        timeline.step_done()
+        return result
 
     def train(
         self,
@@ -242,6 +267,15 @@ class SGD:
         )
         if wd is not None:
             self.last_watchdog_report = wd.report
+        # per-step wall-time attribution (ISSUE 10): data-wait vs
+        # host-dispatch vs device-step vs checkpoint-stall, fenced
+        # every `timeline_sample_period` steps. Exposed for bench
+        # drivers as `last_timeline`; totals feed the `trainer.*`
+        # registry counters and one `timeline` event per pass.
+        tl = StepTimeline(
+            sample_period=_flags.get_flag("timeline_sample_period")
+        )
+        self.last_timeline = tl
         # SIGTERM -> flag; checked at batch boundaries only, so the
         # in-flight jitted step always completes before the flush.
         # Installed only when there is somewhere to flush to.
@@ -256,18 +290,36 @@ class SGD:
                 event_handler(BeginPass(pass_id))
                 evals = self._make_evaluators()
                 costs = []
-                for batch_id, raw in enumerate(reader()):
+                batch_iter = iter(reader())
+                batch_id = -1
+                while True:
+                    t_data = time.perf_counter()
+                    try:
+                        raw = next(batch_iter)
+                    except StopIteration:
+                        break
+                    batch_id += 1
                     if pass_id == start_pass and batch_id < skip_batches:
                         # already trained before the preemption (their
                         # work lives in the flushed checkpoint) — the
                         # deterministic reader replays them, the loop
                         # drops them
                         continue
+                    # reader-next + feeder conversion = the input
+                    # pipeline's blocking share of this step; the
+                    # user's BeginIteration handler is deliberately
+                    # outside it (its cost is not the reader's)
+                    dt_reader = time.perf_counter() - t_data
                     event_handler(BeginIteration(pass_id, batch_id))
+                    t_feed = time.perf_counter()
                     feed = feeder(raw)
+                    tl.add_data_wait(
+                        dt_reader + time.perf_counter() - t_feed
+                    )
                     with GLOBAL_STATS.timer("train_step"):
                         cost, finite, outs = self.run_step(
-                            feed, wd.lr_scale() if wd else 1.0
+                            feed, wd.lr_scale() if wd else 1.0,
+                            timeline=tl,
                         )
                     if finite:
                         costs.append(cost)
@@ -317,6 +369,7 @@ class SGD:
                         TestResult(pass_id, tr["cost"], tr["evaluators"])
                     )
                 if save_dir:
+                    t_ck = time.perf_counter()
                     with GLOBAL_STATS.timer("checkpoint_save"):
                         if ckpt_mode == "async":
                             # every process commits its own shard; only the
@@ -338,6 +391,7 @@ class SGD:
                                 meta={"global_step": self.global_step},
                                 save_only_one=_flags.get_flag("save_only_one"),
                             )
+                    tl.add_checkpoint(time.perf_counter() - t_ck)
                     if wd is not None:
                         # candidate only: promoted to the rollback
                         # target after `good_batches` healthy batches
@@ -348,6 +402,12 @@ class SGD:
                 # reset after logging so each pass reports only itself
                 log.info("pass %d %s", pass_id, GLOBAL_STATS.report())
                 GLOBAL_STATS.reset()
+                # one structured timeline record per pass on the
+                # event stream (cumulative over this train() call),
+                # plus the human-readable fractions in the log
+                tl.emit_pass(pass_id, self.global_step)
+                log.info("pass %d timeline %s", pass_id,
+                         tl.fractions())
                 event_handler(EndPass(pass_id, results))
             ok = True
         finally:
@@ -393,10 +453,8 @@ class SGD:
                         f"rollback target pass {target} unloadable "
                         f"({type(e).__name__}: {e}) — rotated away?"
                     )
-                    wd.report.events.append(wdg.WatchdogEvent(
-                        "abort", self.global_step,
-                        {"reason": wd.report.abort_reason},
-                    ))
+                    wd.record_event("abort", self.global_step,
+                                    reason=wd.report.abort_reason)
                     log.error("watchdog abort: %s",
                               wd.report.abort_reason)
                     raise wdg.WatchdogAbort(wd.report) from e
@@ -435,6 +493,11 @@ class SGD:
                     jax.device_get(self.state),
                     meta=meta,
                 )
+        _obs.get_registry().counter("trainer.preemptions").inc()
+        _obs.get_registry().event(
+            "preempt_flush", global_step=self.global_step,
+            pass_id=pass_id, batch_in_pass=batches_done,
+        )
         log.warning(
             "preempted: flushed pass %d at batch %d to %s; exiting "
             "for resume", pass_id, batches_done, save_dir,

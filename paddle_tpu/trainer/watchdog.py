@@ -43,7 +43,15 @@ maps to `EXIT_PREEMPTED` (75, EX_TEMPFAIL) — the exit code
 `launch.py` recognizes and respawns, making `kill -TERM` lossless.
 
 Import-light on purpose (no jax): launch.py and the CLI import the
-exit-code contract without paying for a device runtime.
+exit-code contract without paying for a device runtime. (obs.metrics
+is jax-free by lint, so the telemetry wiring keeps that property.)
+
+Telemetry (ISSUE 10): every ladder event is simultaneously (a) kept
+on the structured `WatchdogReport`, (b) counted in the process
+registry (`watchdog.events{kind=...}`), and (c) emitted on the JSONL
+event stream with its `global_step` stamp — so NaN-detect latency and
+rollback cost are computed from the stream by the bench rows instead
+of grepped out of logs.
 """
 
 from __future__ import annotations
@@ -53,6 +61,8 @@ import signal
 import threading
 from dataclasses import dataclass, field
 from typing import List, Optional
+
+from paddle_tpu.obs import metrics as _metrics
 
 # EX_TEMPFAIL: "temporary failure, retry" — the one exit code in the
 # sysexits range that means exactly what a preemption is. launch.py
@@ -173,6 +183,7 @@ class Watchdog:
     def __init__(self, config: Optional[WatchdogConfig] = None):
         self.config = config or WatchdogConfig()
         self.report = WatchdogReport()
+        self._reg = _metrics.get_registry()
         # EWMA loss statistics
         self._mean: Optional[float] = None
         self._var = 0.0
@@ -189,6 +200,21 @@ class Watchdog:
         self._candidate_pass: Optional[int] = None
         self._candidate_healthy = 0
         self._good_pass: Optional[int] = None
+
+    # ---- telemetry ----
+    def record_event(self, kind: str, global_step: int,
+                     **detail) -> None:
+        """One ladder event, recorded everywhere at once: the report
+        (postmortems), the registry counter (metricz), and the JSONL
+        event stream (latency measurement). Used by the trainer too
+        (rollback-unloadable aborts) so the three records can never
+        disagree."""
+        self.report.events.append(
+            WatchdogEvent(kind, global_step, detail)
+        )
+        self._reg.counter("watchdog.events").inc(kind=kind)
+        self._reg.event("watchdog", event=kind,
+                        global_step=global_step, **detail)
 
     # ---- checkpoint promotion ----
     @property
@@ -211,10 +237,8 @@ class Watchdog:
         if self._candidate_healthy >= self.config.good_batches:
             self._good_pass = self._candidate_pass
             self.report.last_good_pass = self._good_pass
-            self.report.events.append(WatchdogEvent(
-                "promote", global_step,
-                {"pass_id": self._candidate_pass},
-            ))
+            self.record_event("promote", global_step,
+                              pass_id=self._candidate_pass)
             self._candidate_pass = None
 
     def _demote_candidate(self) -> None:
@@ -228,11 +252,15 @@ class Watchdog:
         path; `lr_backoff` right after a spike, linearly re-warming)."""
         return self._scale
 
-    def _start_backoff(self) -> None:
+    def _start_backoff(self, global_step: int) -> None:
         c = self.config
         self._scale = c.lr_backoff
         self._rewarm_left = max(c.lr_rewarm_batches, 1)
         self.report.backoffs += 1
+        self.record_event(
+            "backoff", global_step, lr_scale=c.lr_backoff,
+            rewarm_batches=self._rewarm_left,
+        )
 
     def _advance_rewarm(self) -> None:
         if self._rewarm_left <= 0:
@@ -252,9 +280,7 @@ class Watchdog:
         post-rollback loss distribution is the checkpoint's, not the
         diverged run's."""
         self.report.rollbacks += 1
-        self.report.events.append(WatchdogEvent(
-            "rollback", global_step, {"pass_id": pass_id},
-        ))
+        self.record_event("rollback", global_step, pass_id=pass_id)
         self._mean = None
         self._var = 0.0
         self._observed = 0
@@ -277,12 +303,10 @@ class Watchdog:
             self._demote_candidate()
             self._consecutive_skips += 1
             self.report.skipped_batches += 1
-            self.report.events.append(WatchdogEvent(
-                "skip", global_step,
-                {"loss": repr(loss),
-                 "budget_left":
-                     c.skip_budget - self._consecutive_skips},
-            ))
+            self.record_event(
+                "skip", global_step, loss=repr(loss),
+                budget_left=c.skip_budget - self._consecutive_skips,
+            )
             if self._consecutive_skips > c.skip_budget:
                 return self._escalate(global_step,
                                       "skip budget exhausted")
@@ -303,17 +327,16 @@ class Watchdog:
             self._demote_candidate()
             self.report.spikes += 1
             self._episode_spikes += 1
-            self.report.events.append(WatchdogEvent(
-                "spike", global_step,
-                {"loss": loss, "ewma_mean": self._mean,
-                 "ewma_std": math.sqrt(max(self._var, 0.0))},
-            ))
+            self.record_event(
+                "spike", global_step, loss=loss, ewma_mean=self._mean,
+                ewma_std=math.sqrt(max(self._var, 0.0)),
+            )
             # the spiking loss is NOT folded into the EWMA — it would
             # drag the threshold up and mask a follow-on spike
             if self._episode_spikes >= c.spikes_to_rollback:
                 return self._escalate(global_step,
                                       "repeated loss spikes")
-            self._start_backoff()
+            self._start_backoff(global_step)
             return BACKOFF
 
         # healthy batch: update EWMA mean/var, promote candidates
@@ -338,9 +361,8 @@ class Watchdog:
                        else f": max_rollbacks={self.config.max_rollbacks}"
                             " exceeded")
             )
-            self.report.events.append(WatchdogEvent(
-                "abort", global_step, {"reason": self.report.abort_reason},
-            ))
+            self.record_event("abort", global_step,
+                              reason=self.report.abort_reason)
             return ABORT
         return ROLLBACK
 

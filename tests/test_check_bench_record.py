@@ -56,6 +56,44 @@ def test_compare_catches_dropped_row(tmp_path):
     assert cbr.check_compare(str(stdout), str(record)) == []
 
 
+def test_compare_requires_timeline_triple(tmp_path):
+    """ISSUE 10: a successfully measured north-star row without the
+    data_wait/host/device attribution triple fails the lint; error
+    and budget-skipped rows are exempt (nothing was measured)."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+    bare = {"metric": "resnet50_train_imgs_per_s", "value": 1.0}
+    full = dict(bare, data_wait_frac=0.0, host_overhead_frac=0.1,
+                device_frac=0.9)
+    errored = {"metric": "serve_loadtest", "value": None,
+               "error": "RuntimeError: no chip"}
+    skipped = {"metric": "nmt_beam4_decode_tokens_per_s",
+               "skipped": "budget"}
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    v = lint(bare)
+    assert v and "timeline" in v[0] and "data_wait_frac" in v[0]
+    assert lint(full) == []
+    assert lint(errored) == []
+    assert lint(skipped) == []
+    # non-north-star rows never need the triple
+    assert lint({"metric": "alexnet_train_ms", "value": 2.0}) == []
+
+
+def test_obs_lint_mode_cli():
+    """`check_bench_record.py obs` (the no-jax-at-module-scope lint
+    for paddle_tpu/obs/) exits 0 on the repo."""
+    r = subprocess.run(
+        [sys.executable, "tools/check_bench_record.py", "obs"],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+
 def test_cli_exit_codes(tmp_path):
     r = subprocess.run(
         [sys.executable, "tools/check_bench_record.py", "static"],

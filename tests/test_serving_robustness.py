@@ -574,6 +574,15 @@ class TestServeLoadtestRow:
             assert p["p50_ms"] <= p["p99_ms"]
         # saturation tok/s present + summary carries the row
         assert "goodput_tok_s" in pts[-1]
+        # registry-sourced telemetry (ISSUE 10): the timeline triple
+        # every north-star row carries, queue-depth HWM and mean
+        # occupancy read from the obs registry, not recomputed here
+        for f in ("data_wait_frac", "host_overhead_frac",
+                  "device_frac"):
+            assert 0.0 <= row[f] <= 1.0, (f, row[f])
+        assert row["max_queue_depth"] >= 1
+        occ = row["mean_batch_occupancy"]
+        assert occ is not None and occ >= 1.0
         summary = next(x for x in rows if x["metric"] == "summary")
         assert "serve_loadtest" in summary["north_stars"]
         # the full-row artifact really holds every printed row

@@ -19,10 +19,20 @@ both run by `tests/test_check_bench_record.py`:
   (`mc_checkpoint_overhead`, `mc_preempt_recovery`) are still
   registered in bench_multichip.py — deleting a permanent row is a
   perf-record regression, not a cleanup.
+- **timeline fields** (ISSUE 10): every north-star row must carry the
+  per-step time-attribution triple `data_wait_frac` /
+  `host_overhead_frac` / `device_frac`. compare mode checks the
+  recorded rows; static mode checks `TIMELINE_ROWS` here still equals
+  bench.py's `NORTH_STARS` tuple (drift tripwire).
+- **obs import hygiene** (`obs` subcommand): no module under
+  `paddle_tpu/obs/` may import jax/jaxlib at module top level — the
+  metrics registry must stay importable in serving front ends and
+  data workers without pulling in the device runtime.
 
 Usage:
     python tools/check_bench_record.py static [repo_dir]
     python tools/check_bench_record.py compare STDOUT_FILE RECORD_FILE
+    python tools/check_bench_record.py obs [repo_dir]
 
 Exit 0 = clean, 1 = violation (printed to stderr).
 """
@@ -40,6 +50,22 @@ BENCH_FILES = ("bench.py", "bench_multichip.py")
 # permanent rows the multichip sweep must keep registering (ROADMAP 4 /
 # ISSUE 9: elasticity is measured, not assumed)
 REQUIRED_MC_ROWS = ("mc_checkpoint_overhead", "mc_preempt_recovery")
+
+# north-star rows that must carry the timeline triple (ISSUE 10).
+# MUST equal bench.py's NORTH_STARS — static mode enforces the sync.
+TIMELINE_ROWS = (
+    "resnet50_train_imgs_per_s",
+    "nmt_attention_train_tokens_per_s",
+    "nmt_attention_train_tokens_per_s_bs512",
+    "nmt_attention_train_tokens_per_s_t128",
+    "nmt_beam4_decode_tokens_per_s",
+    "serve_loadtest",
+    "ctr_sparse_step_v_independence",
+    "ctr_widedeep_sparse_v_independence",
+)
+TIMELINE_FIELDS = (
+    "data_wait_frac", "host_overhead_frac", "device_frac",
+)
 
 
 def _is_json_dumps(node: ast.AST) -> bool:
@@ -106,14 +132,92 @@ def check_static(repo_dir: str) -> list:
                 f"longer registered — the elasticity record would "
                 f"silently stop being captured"
             )
+    # TIMELINE_ROWS here must equal bench.py's NORTH_STARS, else the
+    # compare-mode timeline enforcement silently stops covering a row
+    bench_path = os.path.join(repo_dir, "bench.py")
+    with open(bench_path) as f:
+        bench_tree = ast.parse(f.read(), bench_path)
+    north = None
+    for node in bench_tree.body:
+        if isinstance(node, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == "NORTH_STARS"
+            for t in node.targets
+        ):
+            try:
+                north = tuple(ast.literal_eval(node.value))
+            except ValueError:
+                violations.append(
+                    "bench.py NORTH_STARS is no longer a literal "
+                    "tuple — the TIMELINE_ROWS drift tripwire cannot "
+                    "read it; keep it a plain literal"
+                )
+                return violations
+    if north is None:
+        violations.append(
+            "bench.py NORTH_STARS assignment not found — the "
+            "TIMELINE_ROWS drift tripwire has nothing to compare "
+            "against"
+        )
+    elif north != TIMELINE_ROWS:
+        violations.append(
+            "bench.py NORTH_STARS != check_bench_record.TIMELINE_ROWS "
+            "— update both together or timeline-field enforcement "
+            f"drifts (bench: {north}, lint: {TIMELINE_ROWS})"
+        )
+    return violations
+
+
+def check_obs_imports(repo_dir: str) -> list:
+    """No `paddle_tpu/obs/` module may import jax/jaxlib at module
+    scope (function-local imports are fine). Module scope includes
+    try/if blocks and class bodies — anything that executes at import
+    time."""
+    violations = []
+    obs_dir = os.path.join(repo_dir, "paddle_tpu", "obs")
+    if not os.path.isdir(obs_dir):
+        return [f"{obs_dir}: missing — the telemetry package is gone"]
+
+    def walk_module_scope(node):
+        """Yield nodes reachable at import time (skip function
+        bodies, whose imports are lazy)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield child
+            yield from walk_module_scope(child)
+
+    for fname in sorted(os.listdir(obs_dir)):
+        if not fname.endswith(".py"):
+            continue
+        path = os.path.join(obs_dir, fname)
+        with open(path) as f:
+            tree = ast.parse(f.read(), path)
+        for node in walk_module_scope(tree):
+            mods = []
+            if isinstance(node, ast.Import):
+                mods = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom):
+                mods = [node.module or ""]
+            for m in mods:
+                root = m.split(".")[0]
+                if root in ("jax", "jaxlib"):
+                    violations.append(
+                        f"paddle_tpu/obs/{fname}:{node.lineno}: "
+                        f"imports {m!r} at module top level — the "
+                        f"registry must stay importable without the "
+                        f"device runtime (use a function-local "
+                        f"import)"
+                    )
     return violations
 
 
 def check_compare(stdout_path: str, record_path: str) -> list:
     """Every JSON row printed to stdout must appear in the record, at
-    least as many times as it was printed."""
-    def rows(path):
-        out = Counter()
+    least as many times as it was printed; and every successfully
+    measured north-star row must carry the timeline triple."""
+    def parse(path):
+        out = []
         with open(path) as f:
             for ln in f:
                 ln = ln.strip()
@@ -124,10 +228,15 @@ def check_compare(stdout_path: str, record_path: str) -> list:
                 except ValueError:
                     continue
                 if isinstance(d, dict) and "metric" in d:
-                    out[d["metric"]] += 1
+                    out.append(d)
         return out
 
-    printed, recorded = rows(stdout_path), rows(record_path)
+    def counts(rows):
+        return Counter(d["metric"] for d in rows)
+
+    printed_rows = parse(stdout_path)
+    printed = counts(printed_rows)
+    recorded = counts(parse(record_path))
     violations = []
     for metric, n in printed.items():
         if recorded[metric] < n:
@@ -138,15 +247,32 @@ def check_compare(stdout_path: str, record_path: str) -> list:
             )
     if not printed:
         violations.append(f"{stdout_path}: no bench rows found")
+    # timeline enforcement (ISSUE 10): a north-star row that measured
+    # successfully (no error, not budget-skipped) without the
+    # attribution triple means an input-pipeline bubble could hide
+    for d in printed_rows:
+        m = d["metric"]
+        if (m in TIMELINE_ROWS or m.startswith("mc_preempt_recovery")) \
+                and "error" not in d and "skipped" not in d:
+            missing = [f for f in TIMELINE_FIELDS if f not in d]
+            if missing:
+                violations.append(
+                    f"row {m!r}: missing timeline field(s) "
+                    f"{missing} — north-star rows must attribute "
+                    f"their step time (data-wait / host / device)"
+                )
     return violations
 
 
 def main(argv) -> int:
-    if len(argv) >= 2 and argv[1] == "static":
+    if len(argv) >= 2 and argv[1] in ("static", "obs"):
         repo = argv[2] if len(argv) > 2 else os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))
         )
-        violations = check_static(repo)
+        violations = (
+            check_static(repo) if argv[1] == "static"
+            else check_obs_imports(repo)
+        )
     elif len(argv) == 4 and argv[1] == "compare":
         violations = check_compare(argv[2], argv[3])
     else:
