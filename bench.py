@@ -1068,144 +1068,181 @@ def bench_serve_loadtest(vocab=2048, beam=4, max_len=16,
         return dsl.mixed(vocab, [(emb, "identity")], act="softmax",
                          bias=False, name="prob")
 
+    from paddle_tpu.core import flags as _fl
+    from paddle_tpu.obs import flight_recorder as _fr
     from paddle_tpu.obs import metrics as _om
 
-    # the serving stack publishes queue depth / occupancy / request
-    # time attribution into the process registry — the row READS them
-    # (delta over this row's window) instead of recomputing its own
-    reg = _om.get_registry()
-    # counters are delta-corrected against `base` below; the HWM gauge
-    # only ever ratchets up, so an earlier server in this process
-    # would leak its peak into this row — start it fresh
-    reg.gauge("serving.queue_depth_hwm").reset()
-    base = {
-        "batches": reg.counter("serving.batches").get(model="gen"),
-        "batch_requests": reg.counter(
-            "serving.batch_requests").get(model="gen"),
-        "latency": reg.counter("serving.request_latency_s").get(),
-        "queue_wait": reg.counter(
-            "serving.request_queue_wait_s").get(),
-        "dispatch": reg.counter("serving.request_dispatch_s").get(),
-    }
+    # span-derived critical path (ISSUE 11): trace EVERY request for
+    # the row's window (trace_serve_period=1) into a ring-only flight
+    # recorder, then derive the queued / batch-wait / device split
+    # from the spans — cross-checked by the check_bench_record lint
+    # against the registry-derived triple below, so the two
+    # measurement pipes watch each other
+    prev_trace_period = _fl.get_flag("trace_serve_period")
+    _fl.set_flag("trace_serve_period", 1)
+    _span_rec = _fr.enable_flight_recorder(capacity=1 << 16)
+    try:
 
-    dec = BeamSearchDecoder(step, n_static=0, bos_id=0, eos_id=1,
-                            beam_size=beam, max_length=max_len)
-    rng = np.random.default_rng(0)
-    table = rng.standard_normal((vocab, vocab)).astype(np.float32)
-    import jax.numpy as jnp
+        # the serving stack publishes queue depth / occupancy / request
+        # time attribution into the process registry — the row READS them
+        # (delta over this row's window) instead of recomputing its own
+        reg = _om.get_registry()
+        # counters are delta-corrected against `base` below; the HWM gauge
+        # only ever ratchets up, so an earlier server in this process
+        # would leak its peak into this row — start it fresh
+        reg.gauge("serving.queue_depth_hwm").reset()
+        base = {
+            "batches": reg.counter("serving.batches").get(model="gen"),
+            "batch_requests": reg.counter(
+                "serving.batch_requests").get(model="gen"),
+            "latency": reg.counter("serving.request_latency_s").get(),
+            "queue_wait": reg.counter(
+                "serving.request_queue_wait_s").get(),
+            "dispatch": reg.counter("serving.request_dispatch_s").get(),
+        }
 
-    params = {"serve_bigram": jnp.asarray(table)}
-    model = GenerationModel(dec, params)
-    cfg = ServeConfig(max_queue=64, max_batch=8,
-                      default_deadline_s=deadline_s,
-                      buckets=(16, 32, 64))
-    server = InferenceServer(cfg)
-    server.add_model("gen", model)
+        dec = BeamSearchDecoder(step, n_static=0, bos_id=0, eos_id=1,
+                                beam_size=beam, max_length=max_len)
+        rng = np.random.default_rng(0)
+        table = rng.standard_normal((vocab, vocab)).astype(np.float32)
+        import jax.numpy as jnp
 
-    # pre-generated request pool: np.random.Generator is not
-    # thread-safe, and 16 closed-loop threads draw concurrently
-    _pool = [
-        rng.integers(2, vocab,
-                     (int(rng.integers(4, 17)),)).astype(np.int32)
-        for _ in range(256)
-    ]
-    _pool_i = itertools.count()
+        params = {"serve_bigram": jnp.asarray(table)}
+        model = GenerationModel(dec, params)
+        cfg = ServeConfig(max_queue=64, max_batch=8,
+                          default_deadline_s=deadline_s,
+                          buckets=(16, 32, 64))
+        server = InferenceServer(cfg)
+        server.add_model("gen", model)
 
-    def req_ids():
-        return _pool[next(_pool_i) % len(_pool)]
+        # pre-generated request pool: np.random.Generator is not
+        # thread-safe, and 16 closed-loop threads draw concurrently
+        _pool = [
+            rng.integers(2, vocab,
+                         (int(rng.integers(4, 17)),)).astype(np.int32)
+            for _ in range(256)
+        ]
+        _pool_i = itertools.count()
 
-    # warm every batch-bucket program so the sweep measures serving,
-    # not first-compile
-    bb = 1
-    while bb <= cfg.max_batch:
-        pend = [server.submit("gen", req_ids(), deadline_s=600.0)
-                for _ in range(bb)]
-        for p in pend:
-            p.result(timeout=600)
-        bb *= 2
+        def req_ids():
+            return _pool[next(_pool_i) % len(_pool)]
 
-    # capacity probe: closed loop, 2x max_batch concurrent clients
-    done_tok = [0]
-    done_n = [0]
-    stop = threading.Event()
-    lock = threading.Lock()
+        # warm every batch-bucket program so the sweep measures serving,
+        # not first-compile
+        bb = 1
+        while bb <= cfg.max_batch:
+            pend = [server.submit("gen", req_ids(), deadline_s=600.0)
+                    for _ in range(bb)]
+            for p in pend:
+                p.result(timeout=600)
+            bb *= 2
 
-    probe_errors = [0]
+        # capacity probe: closed loop, 2x max_batch concurrent clients
+        done_tok = [0]
+        done_n = [0]
+        stop = threading.Event()
+        lock = threading.Lock()
 
-    def closed_loop():
-        while not stop.is_set():
-            try:
-                r = server.submit("gen", req_ids(),
-                                  deadline_s=deadline_s)
-                out = r.result(timeout=60)
-            except (ServeRejected, TimeoutError):
-                continue
-            except ServeError:
-                # a transient dispatch failure must not silently kill
-                # the probe thread and deflate measured capacity
+        probe_errors = [0]
+
+        def closed_loop():
+            while not stop.is_set():
+                try:
+                    r = server.submit("gen", req_ids(),
+                                      deadline_s=deadline_s)
+                    out = r.result(timeout=60)
+                except (ServeRejected, TimeoutError):
+                    continue
+                except ServeError:
+                    # a transient dispatch failure must not silently kill
+                    # the probe thread and deflate measured capacity
+                    with lock:
+                        probe_errors[0] += 1
+                    continue
                 with lock:
-                    probe_errors[0] += 1
-                continue
-            with lock:
-                done_tok[0] += len(out["tokens"])
-                done_n[0] += 1
+                    done_tok[0] += len(out["tokens"])
+                    done_n[0] += 1
 
-    workers = [threading.Thread(target=closed_loop, daemon=True)
-               for _ in range(2 * cfg.max_batch)]
-    t0 = time.perf_counter()
-    for w in workers:
-        w.start()
-    time.sleep(duration)
-    stop.set()
-    for w in workers:
-        w.join(timeout=30)
-    probe_s = time.perf_counter() - t0
-    cap_rps = max(done_n[0] / probe_s, 1.0)
-    cap_tok_s = done_tok[0] / probe_s
-
-    points = []
-    for mult in (0.5, 1.0, 2.0):
-        rate = cap_rps * mult
-        spacing = 1.0 / rate
-        reqs, shed = [], 0
+        workers = [threading.Thread(target=closed_loop, daemon=True)
+                   for _ in range(2 * cfg.max_batch)]
         t0 = time.perf_counter()
-        nxt = t0
-        while (now := time.perf_counter()) - t0 < duration:
-            if now < nxt:
-                time.sleep(min(nxt - now, 0.005))
-                continue
-            nxt += spacing
-            try:
-                reqs.append(server.submit("gen", req_ids(),
-                                          deadline_s=deadline_s))
-            except ServeRejected:
-                shed += 1
-        # drain this point's tail before measuring
-        deadline = time.monotonic() + deadline_s + 10
-        while time.monotonic() < deadline and any(
-            r.state == "pending" for r in reqs
-        ):
-            time.sleep(0.01)
-        lat = sorted(r.latency_s for r in reqs if r.state == "done")
-        n_done = len(lat)
-        n_deadline = sum(r.state == "rejected:deadline" for r in reqs)
-        tok = sum(len(r._result["tokens"]) for r in reqs
-                  if r.state == "done")
-        offered = len(reqs) + shed
-        points.append({
-            "offered_rps": round(offered / duration, 1),
-            "target_x_capacity": mult,
-            "completed": n_done,
-            "shed_overload": shed,
-            "shed_deadline": n_deadline,
-            "shed_frac": round((shed + n_deadline) / max(offered, 1), 3),
-            "p50_ms": round(lat[n_done // 2] * 1e3, 1) if lat else None,
-            "p99_ms": round(lat[int(0.99 * (n_done - 1))] * 1e3, 1)
-            if lat else None,
-            "goodput_tok_s": round(tok / duration, 1),
-        })
-    server.shutdown(drain=True)
-    sat = max((p["goodput_tok_s"] for p in points), default=0.0)
+        for w in workers:
+            w.start()
+        time.sleep(duration)
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+        probe_s = time.perf_counter() - t0
+        cap_rps = max(done_n[0] / probe_s, 1.0)
+        cap_tok_s = done_tok[0] / probe_s
+
+        points = []
+        for mult in (0.5, 1.0, 2.0):
+            rate = cap_rps * mult
+            spacing = 1.0 / rate
+            reqs, shed = [], 0
+            t0 = time.perf_counter()
+            nxt = t0
+            while (now := time.perf_counter()) - t0 < duration:
+                if now < nxt:
+                    time.sleep(min(nxt - now, 0.005))
+                    continue
+                nxt += spacing
+                try:
+                    reqs.append(server.submit("gen", req_ids(),
+                                              deadline_s=deadline_s))
+                except ServeRejected:
+                    shed += 1
+            # drain this point's tail before measuring
+            deadline = time.monotonic() + deadline_s + 10
+            while time.monotonic() < deadline and any(
+                r.state == "pending" for r in reqs
+            ):
+                time.sleep(0.01)
+            lat = sorted(r.latency_s for r in reqs if r.state == "done")
+            n_done = len(lat)
+            n_deadline = sum(r.state == "rejected:deadline" for r in reqs)
+            tok = sum(len(r._result["tokens"]) for r in reqs
+                      if r.state == "done")
+            offered = len(reqs) + shed
+            points.append({
+                "offered_rps": round(offered / duration, 1),
+                "target_x_capacity": mult,
+                "completed": n_done,
+                "shed_overload": shed,
+                "shed_deadline": n_deadline,
+                "shed_frac": round((shed + n_deadline) / max(offered, 1), 3),
+                "p50_ms": round(lat[n_done // 2] * 1e3, 1) if lat else None,
+                "p99_ms": round(lat[int(0.99 * (n_done - 1))] * 1e3, 1)
+                if lat else None,
+                "goodput_tok_s": round(tok / duration, 1),
+            })
+        server.shutdown(drain=True)
+        sat = max((p["goodput_tok_s"] for p in points), default=0.0)
+        # span-derived critical-path split over the whole window: the
+        # per-request span trees the scheduler stamped (serve.request over
+        # queued / batch_form / dispatch) summed by phase, as fractions of
+        # the completed requests' total span time
+        span_events = _span_rec.spans()
+    finally:
+        # restore even when the row errors mid-sweep: a
+        # leaked trace_serve_period=1 + attached ring would
+        # skew every later row in this process
+        _fr.disable_flight_recorder()
+        _fl.set_flag("trace_serve_period", prev_trace_period)
+    roots_ok = [s for s in span_events
+                if s["name"] == "serve.request"
+                and s["status"] == "ok"]
+    span_total = sum(s["dur_s"] for s in roots_ok)
+    # phase sums restricted to children of OK roots: an errored
+    # dispatch's children would inflate the numerators while its
+    # root is excluded from span_total
+    ok_root_ids = {s["span_id"] for s in roots_ok}
+    phase = {"serve.queued": 0.0, "serve.batch_form": 0.0,
+             "serve.dispatch": 0.0}
+    for s in span_events:
+        if s["name"] in phase and s["parent_id"] in ok_root_ids:
+            phase[s["name"]] += s["dur_s"]
     # registry-sourced serving telemetry (ISSUE 10): queue-depth
     # high-water mark and mean batch occupancy come from the obs
     # registry the server maintains, and the admitted-request time
@@ -1244,6 +1281,16 @@ def bench_serve_loadtest(vocab=2048, beam=4, max_len=16,
         "host_overhead_frac": round(
             max(1.0 - (wait_s + disp_s) / lat_s, 0.0), 4
         ) if lat_s else 0.0,
+        "span_queued_frac": round(
+            phase["serve.queued"] / span_total, 4
+        ) if span_total else 0.0,
+        "span_batch_wait_frac": round(
+            phase["serve.batch_form"] / span_total, 4
+        ) if span_total else 0.0,
+        "span_device_frac": round(
+            phase["serve.dispatch"] / span_total, 4
+        ) if span_total else 0.0,
+        "span_requests": len(roots_ok),
         "probe_errors": probe_errors[0],
     }
 

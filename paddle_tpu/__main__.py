@@ -71,9 +71,14 @@ def cmd_dump_config(args):
 
 def cmd_train(args):
     from paddle_tpu.launch import distributed_init_from_env
+    from paddle_tpu.obs import flight_recorder as _flight
     from paddle_tpu.trainer import SGD
     from paddle_tpu.trainer import events
     from paddle_tpu.trainer import watchdog as wdg
+
+    # PADDLE_FLIGHT_DIR=<dir> arms the anomaly flight recorder
+    # (watchdog rungs dump span/timeline/event bundles there)
+    _flight.enable_from_env()
 
     # under `paddle launch` every worker carries the rendezvous env —
     # join it before any device use (cluster_train trainer_id wiring)
@@ -389,7 +394,12 @@ def cmd_serve(args):
     import signal
     import time as _time
 
+    from paddle_tpu.obs import flight_recorder as _flight
     from paddle_tpu.serving.tcp import ServingTCPServer
+
+    # PADDLE_FLIGHT_DIR=<dir> arms the anomaly flight recorder
+    # (breaker opens / shed spikes / SLO breaches dump bundles there)
+    _flight.enable_from_env()
 
     spec = importlib.util.spec_from_file_location("_serve_config",
                                                   args.config)
@@ -430,6 +440,10 @@ def cmd_metrics(args):
     telemetry must not initialize a device runtime."""
     from paddle_tpu.obs import metrics as om
 
+    if args.spans:
+        if not args.stream:
+            raise SystemExit("--spans needs --stream FILE")
+        return _metrics_spans(args)
     if args.stream:
         from paddle_tpu.testing_faults import read_metrics_records
 
@@ -478,6 +492,85 @@ def cmd_metrics(args):
         print(json.dumps(reg.snapshot(), indent=2))
     else:
         print(reg.render_text())
+    return 0
+
+
+def _metrics_spans(args):
+    """`metrics --stream FILE --spans` (ISSUE 11): per-span-name
+    count/p50/p99 table plus the top-N slowest traces, computed from
+    the span events on a JSONL stream. Jax-free like the rest of the
+    metrics paths — span analytics must run on any box the stream was
+    copied to."""
+    from paddle_tpu.testing_faults import read_metrics_records
+
+    spans = read_metrics_records(args.stream, kind="span")
+    if not spans:
+        print(f"event stream {args.stream}: no span events")
+        return 0
+
+    def pctl(sorted_vals, q):
+        return sorted_vals[int(q * (len(sorted_vals) - 1))]
+
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.get("name", "?"), []).append(
+            float(s.get("dur_s", 0.0))
+        )
+    rows = []
+    for name, durs in by_name.items():
+        durs.sort()
+        rows.append({
+            "name": name,
+            "count": len(durs),
+            "p50_ms": round(pctl(durs, 0.50) * 1e3, 3),
+            "p99_ms": round(pctl(durs, 0.99) * 1e3, 3),
+            "max_ms": round(durs[-1] * 1e3, 3),
+        })
+    rows.sort(key=lambda r: r["p99_ms"] * r["count"], reverse=True)
+
+    # slowest traces: each trace scored by its root span (no parent
+    # within the trace), falling back to its longest span. Root
+    # semantics mirror tools/trace_view.py::_root_of — that file must
+    # stay standalone-stdlib (copyable to any box without this
+    # package), so the few lines are duplicated, not imported; change
+    # both together.
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s.get("trace_id", "?"), []).append(s)
+    traces = []
+    for tid, group in by_trace.items():
+        ids = {g.get("span_id") for g in group}
+        roots = [g for g in group
+                 if g.get("parent_id", "") not in ids]
+        root = max(roots or group,
+                   key=lambda g: float(g.get("dur_s", 0.0)))
+        traces.append({
+            "trace_id": tid,
+            "root": root.get("name"),
+            "dur_ms": round(float(root.get("dur_s", 0.0)) * 1e3, 3),
+            "spans": len(group),
+            "status": root.get("status", "ok"),
+        })
+    traces.sort(key=lambda t: t["dur_ms"], reverse=True)
+    traces = traces[: args.top]
+
+    if args.json:
+        print(json.dumps(
+            {"stream": args.stream, "span_count": len(spans),
+             "by_name": rows, "slowest_traces": traces}, indent=2,
+        ))
+        return 0
+    print(f"event stream {args.stream}: {len(spans)} spans")
+    print(f"{'span':28s} {'count':>7s} {'p50_ms':>10s} "
+          f"{'p99_ms':>10s} {'max_ms':>10s}")
+    for r in rows:
+        print(f"{r['name']:28s} {r['count']:7d} {r['p50_ms']:10.3f} "
+              f"{r['p99_ms']:10.3f} {r['max_ms']:10.3f}")
+    print(f"top {len(traces)} slowest traces:")
+    for t in traces:
+        print(f"  {t['trace_id'][:16]:16s} {t['root'] or '?':24s} "
+              f"{t['dur_ms']:10.3f} ms  {t['spans']:4d} spans  "
+              f"{t['status']}")
     return 0
 
 
@@ -596,6 +689,11 @@ def main(argv=None):
     sp.add_argument("--stream", default="",
                     help="summarize this JSONL event-stream file "
                          "instead of the in-process registry")
+    sp.add_argument("--spans", action="store_true",
+                    help="with --stream: per-span-name count/p50/p99 "
+                         "table and the top-N slowest traces")
+    sp.add_argument("--top", type=int, default=10,
+                    help="with --spans: slowest traces to list")
     sp.set_defaults(fn=cmd_metrics)
 
     sp = sub.add_parser("make_diagram", help="emit graphviz dot of a config")

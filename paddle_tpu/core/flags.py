@@ -45,6 +45,24 @@ _DEFAULTS: dict[str, Any] = {
     # measured end-to-end while steady-state dispatch stays async.
     # 0 = never fence (fetches the loop makes anyway still count).
     "timeline_sample_period": 16,
+    # distributed tracing (obs/tracing.py): trainer step spans ride
+    # the timeline_sample_period fences; serving traces every request
+    # that arrives WITH a carrier, plus every Nth anonymous request
+    # when trace_serve_period > 0 (0 = carrier-bearing only)
+    "trace_serve_period": 0,
+    # flight recorder (obs/flight_recorder.py): ring size, dump rate
+    # limit, dump-dir bound, and the guarded jax-profiler capture hook
+    "flight_ring_capacity": 4096,
+    "flight_min_dump_interval_s": 60.0,
+    "flight_max_bundles": 8,
+    "flight_profiler_capture": False,
+    # anomaly thresholds that trip a flight-recorder dump on the
+    # serving path: admitted-p99 SLO (ms over a 128-request sliding
+    # window; 0 disables) and shed-rate spike (shed fraction over a
+    # serve_shed_window_s window, needing >= 20 decisions)
+    "serve_p99_slo_ms": 0,
+    "serve_shed_rate_threshold": 0.5,
+    "serve_shed_window_s": 5.0,
     # data
     "prefetch_depth": 2,
     # kernels: None = auto (fused Pallas cells on TPU, lax.scan elsewhere)

@@ -34,6 +34,7 @@ import time
 from typing import Optional
 
 from paddle_tpu.obs import metrics as _obs
+from paddle_tpu.obs import tracing as _tracing
 
 _BACKOFF_BASE = 0.05
 _BACKOFF_CAP = 1.0
@@ -68,6 +69,15 @@ _OP_REQUEST_SAVE = 10
 _OP_PING = 11
 _OP_SHUTDOWN = 12
 
+_OP_NAMES = {
+    _OP_ADD_TASK: "add_task", _OP_GET_TASK: "get_task",
+    _OP_TASK_DONE: "task_done", _OP_TASK_FAILED: "task_failed",
+    _OP_PASS_FINISHED: "pass_finished", _OP_START_PASS: "start_pass",
+    _OP_COUNT: "count", _OP_SET_LEASE: "set_lease",
+    _OP_SNAPSHOT: "snapshot", _OP_REQUEST_SAVE: "request_save",
+    _OP_PING: "ping", _OP_SHUTDOWN: "shutdown",
+}
+
 
 class MasterClient:
     def __init__(
@@ -75,13 +85,21 @@ class MasterClient:
         addr: str,
         retry_seconds: float = 30.0,
         connect_timeout: float = 5.0,
+        trace_carrier: Optional[dict] = None,
     ):
-        """`addr` is "host:port"."""
+        """`addr` is "host:port". `trace_carrier`: an explicit tracing
+        carrier ({"trace_id", "span_id"}, obs/tracing.py) this
+        client's RPC spans join — how a trainer's lease/save path
+        stays one trace across the master boundary even when the
+        calling thread carries no tracing context (e.g. a reader
+        thread). With neither a carrier nor an active context, RPCs
+        are untraced (zero overhead)."""
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
         self._port = int(port)
         self._retry = retry_seconds
         self._timeout = connect_timeout
+        self._trace_carrier = trace_carrier
         self._sock: Optional[socket.socket] = None
 
     # ---- wire ----
@@ -150,7 +168,26 @@ class MasterClient:
         read window) — the deadline fires even against a master that
         accepts and then goes silent. `min_timeout` raises the
         per-attempt floor for ops the server legitimately parks
-        (save-model election blocks up to its block_seconds)."""
+        (save-model election blocks up to its block_seconds).
+
+        Tracing: when a context or `trace_carrier` is active, the
+        whole retried RPC is ONE parent span `master.<op>` whose
+        attempts are sibling child spans `master.attempt` — a retry
+        storm reads as N short failed attempts under one RPC, not N
+        unrelated traces."""
+        if self._trace_carrier is not None or \
+                _tracing.current() is not None:
+            name = _OP_NAMES.get(op, str(op))
+            with _tracing.attach(self._trace_carrier):
+                with _tracing.span(f"master.{name}", op=op) as sp:
+                    try:
+                        return self._call_retrying(op, body, sp)
+                    except MasterError as e:
+                        sp.status = type(e).__name__
+                        raise
+        return self._call_retrying(op, body, None)
+
+    def _call_retrying(self, op: int, body: bytes, rpc_span) -> tuple:
         start = time.monotonic()
         deadline = start + self._retry
         attempt = 0
@@ -160,15 +197,28 @@ class MasterClient:
             min_timeout = max(min_timeout, block_s + 5.0)
         reg = _obs.get_registry()
         while True:
+            att = (
+                _tracing.start_span(
+                    "master.attempt", trace_id=rpc_span.trace_id,
+                    parent_id=rpc_span.span_id, attempt=attempt,
+                ) if rpc_span is not None else None
+            )
             try:
                 remaining = deadline - time.monotonic()
-                return self._call_once(
+                result = self._call_once(
                     op, body, timeout=max(remaining, min_timeout)
                 )
+                if att is not None:
+                    att.finish("ok")
+                return result
             except MasterProtocolError:
+                if att is not None:
+                    att.finish("protocol_error")
                 reg.counter("master_client.protocol_errors").inc()
                 raise  # alive-but-wrong peer: retrying hides the bug
             except (OSError, ConnectionError) as e:
+                if att is not None:
+                    att.finish(type(e).__name__)
                 self.close()
                 reg.counter("master_client.retries").inc(op=op)
                 now = time.monotonic()

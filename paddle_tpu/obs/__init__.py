@@ -25,3 +25,10 @@ from paddle_tpu.obs.metrics import (  # noqa: F401
     get_registry,
 )
 from paddle_tpu.obs.timeline import StepTimeline  # noqa: F401
+from paddle_tpu.obs import tracing  # noqa: F401
+from paddle_tpu.obs.flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    enable_flight_recorder,
+    disable_flight_recorder,
+    get_flight_recorder,
+)

@@ -43,12 +43,16 @@ class StepTimeline:
         self.prefix = prefix
         self._reg = registry or _metrics.get_registry()
         self._totals = {p: 0.0 for p in PARTS}
+        # most recent per-part duration — the step-span emitter reads
+        # the split of THIS step after run_step measured it
+        self.last = {p: 0.0 for p in PARTS}
         self._steps = 0
         self._fenced = 0
 
     # ---- accumulation (trainer-side) ----
     def _add(self, part: str, dt: float) -> None:
         self._totals[part] += dt
+        self.last[part] = dt
         self._reg.counter(f"{self.prefix}.{part}_s").inc(dt)
 
     def add_data_wait(self, dt: float) -> None:

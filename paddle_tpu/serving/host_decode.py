@@ -21,6 +21,7 @@ from __future__ import annotations
 import numpy as np
 
 from paddle_tpu.beam_search import NEG_INF, BeamHooks
+from paddle_tpu.obs import tracing as _tracing
 
 
 def _step_fn(dec, b):
@@ -87,8 +88,18 @@ def host_generate(dec, params, statics=None, boots=None, batch_size=None,
     finished = np.zeros((b, k), bool)
     seqs = np.full((b, k, t_max), eos, np.int32)
 
+    # per-token tracing (only when a trace context is attached — the
+    # serving scheduler attaches its dispatch span around this call):
+    # each token step is one span, so the decode rung's time shows up
+    # token-by-token in the request's critical path
+    traced = _tracing.current() is not None
+
     for t in range(t_max):
-        prob, new_mems = step(params, static_feed, mems, words)
+        if traced:
+            with _tracing.span("decode.token", t=t, batch=b):
+                prob, new_mems = step(params, static_feed, mems, words)
+        else:
+            prob, new_mems = step(params, static_feed, mems, words)
         prob = np.asarray(prob)
         v = prob.shape[-1]
         logp = np.log(np.maximum(prob, 1e-20)).reshape(b, k, v)

@@ -46,8 +46,11 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from paddle_tpu.core import flags as _flags
 from paddle_tpu.data.feeder import _bucket
 from paddle_tpu.obs import metrics as _obs
+from paddle_tpu.obs import tracing as _tracing
+from paddle_tpu.obs import flight_recorder as _flight
 
 
 class ServeRejected(Exception):
@@ -94,9 +97,11 @@ class PendingResult:
 
     __slots__ = ("id", "model", "ids", "bucket", "deadline", "hooks",
                  "hooks_key", "t_submit", "t_done", "_event", "_result",
-                 "_exc")
+                 "_exc", "trace_id", "parent_span", "span_id",
+                 "t_popped")
 
-    def __init__(self, model, ids, bucket, deadline, hooks, hooks_key):
+    def __init__(self, model, ids, bucket, deadline, hooks, hooks_key,
+                 trace=None):
         self.id = next(_ids)
         self.model = model
         self.ids = ids
@@ -106,6 +111,13 @@ class PendingResult:
         self.hooks_key = hooks_key
         self.t_submit = time.monotonic()
         self.t_done = None
+        # tracing: (trace_id, parent span from the carrier); span_id
+        # is this request's pre-allocated `serve.request` root so
+        # spans can be stamped post-hoc from any worker thread
+        self.trace_id = trace[0] if trace else None
+        self.parent_span = (trace[1] or "") if trace else ""
+        self.span_id = _tracing.new_span_id() if trace else None
+        self.t_popped = None  # set when batch formation picks it up
         self._event = threading.Event()
         self._result = None
         self._exc = None
@@ -153,6 +165,10 @@ class _Breaker:
         self.failures = 0
         self.opened_at = None
         self.probing = False
+        # set on a closed->open transition; the dispatch path reads
+        # and clears it OUTSIDE the server lock to fire the flight-
+        # recorder dump (file I/O must not run under the hot lock)
+        self.just_opened = False
 
     @property
     def state(self) -> str:
@@ -188,6 +204,7 @@ class _Breaker:
                 was_open = self.opened_at is not None
                 self.opened_at = time.monotonic()
                 if not was_open:
+                    self.just_opened = True
                     _obs.get_registry().counter(
                         "serving.breaker_opens"
                     ).inc(model=self.model)
@@ -199,6 +216,75 @@ class _ModelEntry:
     breaker: _Breaker
     ewma_batch_s: float = 0.0     # EWMA dispatch service time
     dispatch_keys: set = field(default_factory=set)
+
+
+class _AnomalyWatch:
+    """Serving-side flight-recorder triggers (thresholds are flags;
+    see core/flags.py): a shed-rate spike over a sliding window, and
+    an admitted-p99 SLO breach over the last 128 request latencies.
+    All methods are called OUTSIDE the server lock and never raise —
+    anomaly detection must not be able to fail the request path. The
+    recorder's own rate limit is the storm control; this class only
+    decides "is this moment anomalous"."""
+
+    MIN_DECISIONS = 20  # below this a window's shed rate is noise
+
+    def __init__(self):
+        self.window_s = float(_flags.get_flag("serve_shed_window_s"))
+        self.shed_threshold = float(
+            _flags.get_flag("serve_shed_rate_threshold")
+        )
+        self.p99_slo_s = float(_flags.get_flag("serve_p99_slo_ms")) / 1e3
+        self._lock = threading.Lock()
+        self._win_start = time.monotonic()
+        self._admitted = 0
+        self._shed = 0
+        self._lats = deque(maxlen=128)
+
+    def admission(self, shed: bool) -> None:
+        fire = None
+        with self._lock:
+            if shed:
+                self._shed += 1
+            else:
+                self._admitted += 1
+            now = time.monotonic()
+            if now - self._win_start >= self.window_s:
+                total = self._admitted + self._shed
+                rate = self._shed / total if total else 0.0
+                if (total >= self.MIN_DECISIONS
+                        and rate >= self.shed_threshold):
+                    fire = (rate, total)
+                self._win_start = now
+                self._admitted = self._shed = 0
+        if fire is not None:
+            reg = _obs.get_registry()
+            reg.event("serving", event="shed_spike",
+                      shed_rate=round(fire[0], 3), decisions=fire[1])
+            _flight.maybe_dump("shed_spike",
+                               shed_rate=round(fire[0], 3),
+                               decisions=fire[1])
+
+    def latency(self, lat_s: float) -> None:
+        if self.p99_slo_s <= 0:
+            return
+        fire = None
+        with self._lock:
+            self._lats.append(lat_s)
+            if len(self._lats) >= self.MIN_DECISIONS:
+                ordered = sorted(self._lats)
+                p99 = ordered[int(0.99 * (len(ordered) - 1))]
+                if p99 > self.p99_slo_s:
+                    fire = p99
+                    self._lats.clear()  # re-arm on fresh evidence
+        if fire is not None:
+            reg = _obs.get_registry()
+            reg.event("serving", event="slo_breach",
+                      p99_ms=round(fire * 1e3, 3),
+                      slo_ms=round(self.p99_slo_s * 1e3, 3))
+            _flight.maybe_dump("slo_breach",
+                               p99_ms=round(fire * 1e3, 3),
+                               slo_ms=round(self.p99_slo_s * 1e3, 3))
 
 
 class InferenceServer:
@@ -227,6 +313,10 @@ class InferenceServer:
             "shed_shutdown": 0, "failed": 0, "batches": 0,
             "batches_codispatch": 0, "max_queue_depth": 0,
         }
+        self._anomaly = _AnomalyWatch()
+        # recent completed-request exemplars for the `tracez` scrape
+        self._slow: deque = deque(maxlen=256)
+        self._trace_seq = itertools.count(1)  # anonymous-trace sampler
         self._threads = [
             threading.Thread(target=self._worker, name=f"serve-{i}",
                              daemon=True)
@@ -246,14 +336,31 @@ class InferenceServer:
             )
 
     def submit(self, model: str, ids, deadline_s: float = None,
-               hooks=None, hooks_name: str = None) -> PendingResult:
+               hooks=None, hooks_name: str = None,
+               trace=None) -> PendingResult:
         """Admit one request (ids: 1-D int sequence). Raises
         ServeRejected instead of queueing when the server cannot meet
-        it — the explicit-shed contract."""
+        it — the explicit-shed contract.
+
+        `trace`: an optional carrier dict ({"trace_id", "span_id"},
+        the TCP frame's `trace` field) — the request's span tree joins
+        the caller's trace. Without a carrier the thread's tracing
+        context applies, and `trace_serve_period` > 0 additionally
+        samples every Nth anonymous request into a fresh
+        server-originated trace."""
         import numpy as np
 
         cfg = self.config
         reg = _obs.get_registry()
+        tr = _tracing.extract(trace) if trace is not None else None
+        if tr is None:
+            cur = _tracing.current()
+            if cur is not None:
+                tr = cur
+            else:
+                period = _flags.get_flag("trace_serve_period")
+                if period and next(self._trace_seq) % period == 0:
+                    tr = (_tracing.new_trace_id(), "")
         # registry updates are published AFTER self._lock is released
         # (same rule as the completion path): the lock is the admission
         # hot spot, and the registry takes locks of its own
@@ -292,7 +399,7 @@ class InferenceServer:
                 hooks_key = (hooks_name or id(hooks)) \
                     if hooks is not None else None
                 req = PendingResult(model, ids, bucket, deadline,
-                                    hooks, hooks_key)
+                                    hooks, hooks_key, trace=tr)
                 self._queue.append(req)
                 depth = len(self._queue)
                 self._stats["admitted"] += 1
@@ -302,10 +409,20 @@ class InferenceServer:
                 self._work.notify()
         except ServeRejected as e:
             reg.counter("serving.shed").inc(reason=e.reason)
+            if tr is not None:
+                # a shed request still leaves a span: rejection is a
+                # terminal outcome, not a missing trace
+                _tracing.emit_span(
+                    "serve.request", tr[0], _tracing.new_span_id(),
+                    tr[1], dur_s=0.0, status=e.reason,
+                    labels={"model": model},
+                )
+            self._anomaly.admission(shed=True)
             raise
         reg.counter("serving.admitted").inc(model=model)
         reg.gauge("serving.queue_depth").set(depth)
         reg.gauge("serving.queue_depth_hwm").set_max(depth)
+        self._anomaly.admission(shed=False)
         return req
 
     def stats(self) -> dict:
@@ -319,6 +436,16 @@ class InferenceServer:
                 for n, e in self._models.items()
             }
             return out
+
+    def slow_exemplars(self, top: int = 10) -> list:
+        """The `tracez` payload: the slowest of the last 256 completed
+        requests, each carrying its trace_id (when traced) and its
+        queued-vs-dispatch split — the "which requests were slow and
+        where" answer without grepping a span stream."""
+        with self._lock:
+            recent = list(self._slow)
+        recent.sort(key=lambda e: e["latency_ms"], reverse=True)
+        return recent[: max(int(top), 1)]
 
     def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop admission; with drain=True finish (or deadline-reject)
@@ -353,6 +480,15 @@ class InferenceServer:
         self._stats[stat] = self._stats.get(stat, 0) + 1
         _obs.get_registry().counter("serving.shed").inc(reason=reason)
         req._finish(exc=ServeRejected(reason))
+        if req.trace_id is not None:
+            # admitted-then-rejected: the span still closes, covering
+            # the whole admitted phase, with the rejection as status
+            _tracing.emit_span(
+                "serve.request", req.trace_id, req.span_id,
+                req.parent_span, dur_s=req.t_done - req.t_submit,
+                t0_mono=req.t_submit, status=reason,
+                labels={"model": req.model, "id": req.id},
+            )
 
     def _pop_batch_locked(self):
         """Form one dispatchable batch: FIFO head picks the key
@@ -389,6 +525,7 @@ class InferenceServer:
             return None
         entry, first = head
         key = (first.model, first.bucket, first.hooks_key)
+        first.t_popped = time.monotonic()
         batch = [first]
         if entry.breaker.state == "closed":
             rest = []
@@ -399,6 +536,7 @@ class InferenceServer:
                     if now + margin > r.deadline:
                         self._reject_locked(r, "deadline")
                     else:
+                        r.t_popped = time.monotonic()
                         batch.append(r)
                 else:
                     rest.append(r)
@@ -437,6 +575,7 @@ class InferenceServer:
                 if key is None:
                     key = r.bucket
                 if r.bucket == key:
+                    r.t_popped = time.monotonic()
                     picked.append(r)
                 else:
                     rest.append(r)
@@ -489,6 +628,55 @@ class InferenceServer:
             lens[i] = lens[0]
         return ids, lens
 
+    def _emit_request_spans(self, req, t0, t_end, status, path=None,
+                            dispatch_span=None, batch_n=None):
+        """Stamp one admitted request's span tree post-hoc from the
+        monotonic timestamps the scheduler already recorded:
+        serve.request (root, child of the client carrier) over
+        serve.queued / serve.batch_form / serve.dispatch. Safe from
+        any thread — nothing touches the thread-local context."""
+        if req.trace_id is None:
+            return
+        tid, root = req.trace_id, req.span_id
+        labels = {"model": req.model, "id": req.id}
+        if path is not None:
+            labels["path"] = path
+        _tracing.emit_span(
+            "serve.request", tid, root, req.parent_span,
+            dur_s=req.t_done - req.t_submit, t0_mono=req.t_submit,
+            status=status, labels=labels,
+        )
+        tp = req.t_popped if req.t_popped is not None else t0
+        _tracing.emit_span(
+            "serve.queued", tid, _tracing.new_span_id(), root,
+            dur_s=max(tp - req.t_submit, 0.0), t0_mono=req.t_submit,
+        )
+        _tracing.emit_span(
+            "serve.batch_form", tid, _tracing.new_span_id(), root,
+            dur_s=max(t0 - tp, 0.0), t0_mono=tp,
+        )
+        _tracing.emit_span(
+            "serve.dispatch", tid,
+            dispatch_span or _tracing.new_span_id(), root,
+            dur_s=max(t_end - t0, 0.0), t0_mono=t0,
+            labels={"batch": batch_n} if batch_n else {},
+        )
+
+    def _fire_opened_breakers(self, groups):
+        """Outside-the-lock half of the breaker-open anomaly: the
+        transition was flagged under the lock; the event + flight
+        dump (file I/O) happen here."""
+        opened = []
+        with self._lock:
+            for name, (en, _reqs) in groups.items():
+                if en.breaker.just_opened:
+                    en.breaker.just_opened = False
+                    opened.append(name)
+        reg = _obs.get_registry()
+        for name in opened:
+            reg.event("serving", event="breaker_open", model=name)
+            _flight.maybe_dump("breaker_open", model=name)
+
     def _dispatch(self, entry, key, batch, engine=None, extra=None):
         model_name, bucket, hooks_key = key
         hooks = batch[0].hooks
@@ -496,6 +684,20 @@ class InferenceServer:
         groups = {model_name: (entry, batch)}
         if extra:
             groups.update(extra)
+        # decode-rung nesting: the first traced request's dispatch
+        # span is pre-allocated and attached as thread context while
+        # the model runs, so host_decode's per-token spans land under
+        # it (the jitted rung is opaque — one dispatch span is all it
+        # can show)
+        rep = next(
+            (r for _, (_e, reqs) in groups.items() for r in reqs
+             if r.trace_id is not None), None,
+        )
+        rep_dispatch = _tracing.new_span_id() if rep is not None else None
+        run_ctx = _tracing.attach(
+            {"trace_id": rep.trace_id, "span_id": rep_dispatch}
+            if rep is not None else None
+        )
         t0 = time.monotonic()
         jit_failure_counted = False
         try:
@@ -504,13 +706,16 @@ class InferenceServer:
                     name: self._pack(reqs, reqs[0].bucket)
                     for name, (_, reqs) in groups.items()
                 }
-                results = engine.run_group(packed)
+                with run_ctx:
+                    results = engine.run_group(packed)
                 with self._lock:
                     self._stats["batches_codispatch"] += 1
             else:
                 ids, lens = self._pack(batch, bucket)
                 try:
-                    rows = entry.model.run_batch(ids, lens, hooks, host)
+                    with run_ctx:
+                        rows = entry.model.run_batch(ids, lens, hooks,
+                                                     host)
                 except Exception:
                     if host or not self.config.host_fallback or not \
                             getattr(entry.model, "can_host", False):
@@ -522,10 +727,18 @@ class InferenceServer:
                     with self._lock:
                         entry.breaker.record(False)
                     jit_failure_counted = True
-                    rows = entry.model.run_batch(ids, lens, hooks, True)
+                    with _tracing.attach(
+                        {"trace_id": rep.trace_id,
+                         "span_id": rep_dispatch}
+                        if rep is not None else None
+                    ):
+                        rows = entry.model.run_batch(ids, lens, hooks,
+                                                     True)
                     host = True
                 results = {model_name: rows}
         except Exception as e:
+            t_end = time.monotonic()
+            failed = []
             with self._lock:
                 for name, (en, reqs) in groups.items():
                     if not (jit_failure_counted and en is entry):
@@ -535,6 +748,15 @@ class InferenceServer:
                         r._finish(exc=ServeError(
                             f"{type(e).__name__}: {e}"
                         ))
+                        if r.trace_id is not None:
+                            failed.append(r)
+            for r in failed:
+                self._emit_request_spans(
+                    r, t0, t_end, status="error",
+                    dispatch_span=rep_dispatch if r is rep else None,
+                    batch_n=len(batch),
+                )
+            self._fire_opened_breakers(groups)
             return
         dt = time.monotonic() - t0
         # per-request latencies are collected under the lock but
@@ -565,10 +787,33 @@ class InferenceServer:
                         self._stats["completed_host"] += 1
                     lats.append(r.t_done - r.t_submit)
                     waits.append(max(t0 - r.t_submit, 0.0))
-                telemetry.append((name, lats, waits))
+                telemetry.append((name, lats, waits, list(reqs)))
+        t_end = t0 + dt
+        path_label = "host" if host else "jit"
         reg = _obs.get_registry()
         reg.counter("serving.dispatch_s").inc(dt)
-        for name, lats, waits in telemetry:
+        for name, lats, waits, reqs in telemetry:
+            for r in reqs:
+                self._emit_request_spans(
+                    r, t0, t_end, status="ok", path=path_label,
+                    dispatch_span=rep_dispatch if r is rep else None,
+                    batch_n=len(reqs),
+                )
+                lat = r.t_done - r.t_submit
+                tp = r.t_popped if r.t_popped is not None else t0
+                self._slow.append({
+                    "id": r.id,
+                    "model": r.model,
+                    "trace_id": r.trace_id,
+                    "latency_ms": round(lat * 1e3, 3),
+                    "queued_ms": round(
+                        max(tp - r.t_submit, 0.0) * 1e3, 3
+                    ),
+                    "dispatch_ms": round(dt * 1e3, 3),
+                    "path": path_label,
+                    "ts": round(time.time(), 3),
+                })
+                self._anomaly.latency(lat)
             # occupancy bookkeeping: one formed batch per group, its
             # real (un-padded) request count alongside — mean
             # occupancy = batch_requests / batches, read by the
@@ -587,3 +832,8 @@ class InferenceServer:
             hist = reg.histogram("serving.admitted_latency_s")
             for lat in lats:
                 hist.observe(lat, model=name)
+        # a breaker can open on THIS dispatch even though it
+        # completed: a jit failure rescued by the host fallback
+        # counts toward the breaker mid-dispatch, so the open must be
+        # fired from the success path too, not only the except path
+        self._fire_opened_breakers(groups)

@@ -3,18 +3,29 @@
 Frame = 4-byte LE length + UTF-8 JSON. Request:
 
     {"model": str, "ids": [int, ...], "deadline_ms": int?,
-     "hooks": str?}            # hooks = a model-registered hook name
+     "hooks": str?,            # hooks = a model-registered hook name
+     "trace": {"trace_id": str, "span_id": str}?}   # trace carrier
   | {"metricz": true}          # telemetry scrape (no inference)
+  | {"tracez": true, "top": int?}   # slow-request exemplars
 
 Response:
 
     {"ok": true, "id": int, "tokens": [...], "score": float,
-     "path": "jit"|"host", "latency_ms": float}
+     "path": "jit"|"host", "latency_ms": float, "trace_id": str?}
   | {"ok": false, "error": "overloaded"|"deadline"|"quarantined"|
      "shutting_down"|"unknown_model"|"unknown_hook"|"execution"|
      "bad_request"}
   | {"ok": true, "metricz": <registry snapshot>, "stats": <server
      stats>}                   # for a metricz request
+  | {"ok": true, "tracez": [exemplar, ...]}   # for a tracez request
+
+The `trace` carrier makes one trace_id span the whole request path:
+the client's `client.request` span, the server's `serve.request` root
+and its queued / batch-form / dispatch / decode children all join the
+caller's trace (obs/tracing.py). `tracez`, like `metricz`, is
+answered OUTSIDE the admission queue: the slow-request exemplars
+(latency + queued-vs-dispatch split + trace_id) stay scrapeable while
+the server sheds.
 
 `metricz` serves the process-wide obs registry (queue depth +
 high-water mark, batch occupancy, shed/breaker counts, admitted-
@@ -39,6 +50,7 @@ import struct
 import threading
 
 from paddle_tpu.obs import metrics as _obs
+from paddle_tpu.obs import tracing as _tracing
 from paddle_tpu.serving.server import (
     InferenceServer,
     ServeError,
@@ -144,6 +156,17 @@ class ServingTCPServer:
                 "metricz": _obs.get_registry().snapshot(),
                 "stats": self.server.stats(),
             }
+        if isinstance(msg, dict) and msg.get("tracez"):
+            # slow-request exemplars: also outside the admission queue
+            try:
+                top = int(msg.get("top", 10))
+            except (TypeError, ValueError):
+                return {"ok": False, "error": "bad_request",
+                        "detail": f"top={msg.get('top')!r}"}
+            return {
+                "ok": True,
+                "tracez": self.server.slow_exemplars(top=top),
+            }
         try:
             model = msg["model"]
             ids = msg["ids"]
@@ -151,11 +174,12 @@ class ServingTCPServer:
                 msg["deadline_ms"] / 1e3 if "deadline_ms" in msg else None
             )
             hooks_name = msg.get("hooks")
+            trace = msg.get("trace")
         except (KeyError, TypeError):
             return {"ok": False, "error": "bad_request"}
         try:
             req = self.server.submit(model, ids, deadline_s=deadline_s,
-                                     hooks_name=hooks_name)
+                                     hooks_name=hooks_name, trace=trace)
         except ServeRejected as e:
             return {"ok": False, "error": e.reason, "detail": str(e)}
         except Exception as e:
@@ -178,6 +202,8 @@ class ServingTCPServer:
                     "id": req.id}
         resp = {"ok": True, "id": req.id,
                 "latency_ms": round(req.latency_s * 1e3, 3)}
+        if req.trace_id is not None:
+            resp["trace_id"] = req.trace_id
         resp.update(out)
         return resp
 
@@ -223,17 +249,43 @@ class ServeClient:
         self._sock.settimeout(None)
 
     def call(self, model: str, ids, deadline_ms: int = None,
-             hooks: str = None, timeout: float = None) -> dict:
+             hooks: str = None, timeout: float = None,
+             trace=None) -> dict:
+        """`trace`: None = inherit any active tracing context (the
+        request joins it, with a `client.request` span around the
+        roundtrip); True = force a fresh trace even without context;
+        a carrier dict = join that remote trace; False = never
+        trace."""
         msg = {"model": model, "ids": list(map(int, ids))}
         if deadline_ms is not None:
             msg["deadline_ms"] = int(deadline_ms)
         if hooks is not None:
             msg["hooks"] = hooks
+        if isinstance(trace, dict):
+            with _tracing.attach(trace):
+                return self._traced_roundtrip(msg, timeout)
+        if trace is True or (trace is None
+                             and _tracing.current() is not None):
+            return self._traced_roundtrip(msg, timeout)
         return self._roundtrip(msg, timeout)
+
+    def _traced_roundtrip(self, msg: dict, timeout) -> dict:
+        with _tracing.span("client.request",
+                           model=msg.get("model", "")) as sp:
+            msg["trace"] = _tracing.inject()
+            resp = self._roundtrip(msg, timeout)
+            if isinstance(resp, dict) and not resp.get("ok", False):
+                sp.status = resp.get("error", "error")
+            return resp
 
     def metricz(self, timeout: float = None) -> dict:
         """Scrape the server's registry snapshot + stats."""
         return self._roundtrip({"metricz": True}, timeout)
+
+    def tracez(self, top: int = 10, timeout: float = None) -> dict:
+        """Scrape the server's slow-request exemplars."""
+        return self._roundtrip({"tracez": True, "top": int(top)},
+                               timeout)
 
     def _roundtrip(self, msg: dict, timeout: float = None) -> dict:
         if self._sock is None:

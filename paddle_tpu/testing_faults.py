@@ -92,6 +92,10 @@ _mf = os.environ.get("METRICS_FILE")
 if _mf:
     from paddle_tpu.obs import metrics as _om
     _om.enable_event_stream(_mf, flush_interval_s=0.2)
+# PADDLE_FLIGHT_DIR: arm the anomaly flight recorder (watchdog rungs
+# dump span/timeline bundles there — the 5c investigation hook)
+from paddle_tpu.obs import flight_recorder as _fr
+_fr.enable_from_env()
 
 from paddle_tpu import dsl
 from paddle_tpu.core.config import OptimizationConf

@@ -22,6 +22,7 @@ from paddle_tpu.core import rng as _rng
 from paddle_tpu.core.config import ModelConf, OptimizationConf
 from paddle_tpu.core.stat import GLOBAL_STATS
 from paddle_tpu.obs import metrics as _obs
+from paddle_tpu.obs import tracing as _tracing
 from paddle_tpu.obs.timeline import StepTimeline
 from paddle_tpu.evaluators import create_evaluator
 from paddle_tpu.network import Network
@@ -54,6 +55,42 @@ class _NullPreemptionGuard:
 
     def __exit__(self, *exc):
         return False
+
+
+def _emit_step_spans(trace_id, trace_parent, tl, pass_id, batch_id,
+                     global_step, t_data, t_rs):
+    """Span tree for one SAMPLED training step (ISSUE 11): a
+    `train.step` root over `train.data_wait` / `train.host_dispatch` /
+    `train.device_step` children, stamped from the exact perf_counter
+    boundaries the StepTimeline just accumulated. The loop uses
+    perf_counter; spans want wall starts — convert via the current
+    perf->wall offset (both clocks are process-local)."""
+    now_pc = time.perf_counter()
+    now_wall = time.time()
+
+    def wall(t_pc):
+        return now_wall - (now_pc - t_pc)
+
+    root = _tracing.new_span_id()
+    _tracing.emit_span(
+        "train.step", trace_id, root, trace_parent,
+        dur_s=now_pc - t_data, ts=wall(t_data),
+        labels={"pass_id": pass_id, "batch_id": batch_id,
+                "global_step": global_step, "sampled": True},
+    )
+    _tracing.emit_span(
+        "train.data_wait", trace_id, _tracing.new_span_id(), root,
+        dur_s=tl.last["data_wait"], ts=wall(t_data),
+    )
+    _tracing.emit_span(
+        "train.host_dispatch", trace_id, _tracing.new_span_id(), root,
+        dur_s=tl.last["host_dispatch"], ts=wall(t_rs),
+    )
+    _tracing.emit_span(
+        "train.device_step", trace_id, _tracing.new_span_id(), root,
+        dur_s=tl.last["device_step"],
+        ts=wall(t_rs + tl.last["host_dispatch"]),
+    )
 
 
 class SGD:
@@ -276,6 +313,18 @@ class SGD:
             sample_period=_flags.get_flag("timeline_sample_period")
         )
         self.last_timeline = tl
+        # one trace per train() call; sampled steps (the timeline's
+        # fence points) each emit a span tree — train.step over
+        # data_wait / host_dispatch / device_step — aligned with the
+        # very timestamps the timeline accumulated, so the span view
+        # and the fraction view can never disagree about a step.
+        # Joins the launching process's trace when the carrier env
+        # var is set (tracing.CARRIER_ENV), else starts its own.
+        with _tracing.attach_from_env():
+            cur = _tracing.current()
+        trace_id = cur[0] if cur else _tracing.new_trace_id()
+        trace_parent = cur[1] if cur else ""
+        self.last_trace_id = trace_id
         # SIGTERM -> flag; checked at batch boundaries only, so the
         # in-flight jitted step always completes before the flush.
         # Installed only when there is somewhere to flush to.
@@ -316,10 +365,23 @@ class SGD:
                     tl.add_data_wait(
                         dt_reader + time.perf_counter() - t_feed
                     )
+                    t_rs = time.perf_counter()
                     with GLOBAL_STATS.timer("train_step"):
                         cost, finite, outs = self.run_step(
                             feed, wd.lr_scale() if wd else 1.0,
                             timeline=tl,
+                        )
+                    if (tl.sample_period > 0
+                            and self.global_step % tl.sample_period
+                            == 0):
+                        # sampled (fenced) step: the device is quiet
+                        # and every segment of this step is measured —
+                        # emit its span tree (no-op without a stream
+                        # or flight recorder attached)
+                        _emit_step_spans(
+                            trace_id, trace_parent, tl, pass_id,
+                            batch_id, self.global_step - 1, t_data,
+                            t_rs,
                         )
                     if finite:
                         costs.append(cost)
@@ -391,7 +453,14 @@ class SGD:
                                 meta={"global_step": self.global_step},
                                 save_only_one=_flags.get_flag("save_only_one"),
                             )
-                    tl.add_checkpoint(time.perf_counter() - t_ck)
+                    dt_ck = time.perf_counter() - t_ck
+                    tl.add_checkpoint(dt_ck)
+                    _tracing.emit_span(
+                        "train.checkpoint", trace_id,
+                        _tracing.new_span_id(), trace_parent,
+                        dur_s=dt_ck,
+                        labels={"pass_id": pass_id, "mode": ckpt_mode},
+                    )
                     if wd is not None:
                         # candidate only: promoted to the rollback
                         # target after `good_batches` healthy batches

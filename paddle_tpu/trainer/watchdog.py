@@ -63,6 +63,14 @@ from dataclasses import dataclass, field
 from typing import List, Optional
 
 from paddle_tpu.obs import metrics as _metrics
+from paddle_tpu.obs import flight_recorder as _flight
+
+# ladder rungs that count as anomalies: each one trips a (rate-
+# limited) flight-recorder dump so the spans/timeline/events leading
+# up to the rung survive the process. promote/rewarmed are healthy.
+ANOMALY_RUNGS = frozenset(
+    {"skip", "spike", "backoff", "rollback", "abort"}
+)
 
 # EX_TEMPFAIL: "temporary failure, retry" — the one exit code in the
 # sysexits range that means exactly what a preemption is. launch.py
@@ -215,6 +223,11 @@ class Watchdog:
         self._reg.counter("watchdog.events").inc(kind=kind)
         self._reg.event("watchdog", event=kind,
                         global_step=global_step, **detail)
+        if kind in ANOMALY_RUNGS:
+            # the dump happens AFTER the event above, so the bundle's
+            # ring contains the rung that tripped it
+            _flight.maybe_dump(f"watchdog_{kind}",
+                               global_step=global_step, **detail)
 
     # ---- checkpoint promotion ----
     @property

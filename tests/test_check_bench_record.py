@@ -84,6 +84,84 @@ def test_compare_requires_timeline_triple(tmp_path):
     assert lint({"metric": "alexnet_train_ms", "value": 2.0}) == []
 
 
+def test_compare_requires_serve_span_split(tmp_path):
+    """ISSUE 11: a measured serve_loadtest row must carry the
+    span-derived split AND it must reconcile with the registry
+    triple; disagreement beyond tolerance is a lint failure."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+    base = {
+        "metric": "serve_loadtest", "value": 10.0,
+        "data_wait_frac": 0.4, "host_overhead_frac": 0.1,
+        "device_frac": 0.5,
+    }
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    # missing span fields -> violation naming them
+    v = lint(base)
+    assert v and "span field" in v[0]
+    # agreeing split -> clean
+    good = dict(base, span_queued_frac=0.38,
+                span_batch_wait_frac=0.03, span_device_frac=0.52)
+    assert lint(good) == []
+    # wait split disagrees beyond tolerance -> violation
+    bad_wait = dict(good, span_queued_frac=0.05,
+                    span_batch_wait_frac=0.01)
+    v = lint(bad_wait)
+    assert v and "disagrees" in v[0]
+    # device split disagrees -> violation
+    bad_dev = dict(good, span_device_frac=0.9)
+    v = lint(bad_dev)
+    assert v and "span_device_frac" in v[0]
+    # errored rows stay exempt
+    assert lint({"metric": "serve_loadtest", "value": None,
+                 "error": "x"}) == []
+
+
+def test_bundle_lint_cli(tmp_path):
+    """`check_bench_record.py bundle F...` exits 0 on a well-formed
+    bundle, 1 with the violation printed otherwise."""
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({
+        "schema": "paddle-tpu-flight-bundle/v1", "reason": "t",
+        "ts": 1.0, "pid": 1, "seq": 1, "events": [
+            {"kind": "span", "name": "a", "trace_id": "t",
+             "span_id": "s", "parent_id": "", "ts": 1.0,
+             "dur_s": 0.1, "status": "ok"},
+        ], "metrics": {}, "profile": {"captured": False},
+    }))
+    r = subprocess.run(
+        [sys.executable, "tools/check_bench_record.py", "bundle",
+         str(ok)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"schema": "nope"}))
+    r = subprocess.run(
+        [sys.executable, "tools/check_bench_record.py", "bundle",
+         str(ok), str(bad)],
+        cwd=REPO, capture_output=True, text=True,
+    )
+    assert r.returncode == 1 and "schema" in r.stderr
+
+
+def test_obs_lint_requires_tracing_modules(tmp_path):
+    """The obs lint pins the package's required modules: an obs/
+    without tracing.py (or flight_recorder.py) fails the lint even if
+    every present file is import-clean."""
+    obs = tmp_path / "paddle_tpu" / "obs"
+    obs.mkdir(parents=True)
+    for f in ("metrics.py", "timeline.py", "flight_recorder.py"):
+        (obs / f).write_text("x = 1\n")
+    v = cbr.check_obs_imports(str(tmp_path))
+    assert v and "tracing.py" in v[0] and "deleted" in v[0]
+
+
 def test_obs_lint_mode_cli():
     """`check_bench_record.py obs` (the no-jax-at-module-scope lint
     for paddle_tpu/obs/) exits 0 on the repo."""

@@ -392,6 +392,8 @@ class TestObsImportHygiene:
 
         obs = tmp_path / "paddle_tpu" / "obs"
         obs.mkdir(parents=True)
+        for required in cbr.REQUIRED_OBS_MODULES:
+            (obs / required).write_text("x = 1\n")
         (obs / "bad.py").write_text(
             "try:\n    import jax.numpy as jnp\nexcept ImportError:\n"
             "    jnp = None\n"
@@ -409,10 +411,16 @@ class TestObsImportHygiene:
             "sys.modules['jax'] = None\n"  # any import attempt dies
             "import paddle_tpu.obs\n"
             "from paddle_tpu.obs import metrics, timeline\n"
+            "from paddle_tpu.obs import tracing, flight_recorder\n"
             "from paddle_tpu.core import stat\n"
             "from paddle_tpu.trainer import watchdog\n"
             "r = metrics.get_registry()\n"
             "r.counter('ok').inc()\n"
+            "rec = flight_recorder.FlightRecorder(registry=r)\n"
+            "r.attach_recorder(rec)\n"
+            "with tracing.span('no-jax', registry=r):\n"
+            "    pass\n"
+            "assert rec.spans()[0]['name'] == 'no-jax'\n"
             "print('OK', r.counter('ok').get())\n"
         )
         r = subprocess.run(

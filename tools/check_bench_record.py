@@ -27,12 +27,25 @@ both run by `tests/test_check_bench_record.py`:
 - **obs import hygiene** (`obs` subcommand): no module under
   `paddle_tpu/obs/` may import jax/jaxlib at module top level — the
   metrics registry must stay importable in serving front ends and
-  data workers without pulling in the device runtime.
+  data workers without pulling in the device runtime. The scan also
+  pins the package's REQUIRED modules (metrics, timeline, tracing,
+  flight_recorder): deleting one is an observability regression, not
+  a cleanup.
+- **serve span split** (ISSUE 11, compare mode): a measured
+  `serve_loadtest` row must carry the span-derived critical-path
+  split (`span_queued_frac` / `span_batch_wait_frac` /
+  `span_device_frac`) AND it must agree with the registry-derived
+  triple the row already carries, within SPAN_SPLIT_TOL — two
+  independent measurement paths cross-checking each other.
+- **bundle schema** (`bundle` subcommand): static lint of
+  flight-recorder bundles (obs/flight_recorder.py) — schema tag,
+  required top-level fields, well-formed span events.
 
 Usage:
     python tools/check_bench_record.py static [repo_dir]
     python tools/check_bench_record.py compare STDOUT_FILE RECORD_FILE
     python tools/check_bench_record.py obs [repo_dir]
+    python tools/check_bench_record.py bundle BUNDLE.json [...]
 
 Exit 0 = clean, 1 = violation (printed to stderr).
 """
@@ -65,6 +78,30 @@ TIMELINE_ROWS = (
 )
 TIMELINE_FIELDS = (
     "data_wait_frac", "host_overhead_frac", "device_frac",
+)
+
+# serve_loadtest span-derived split (ISSUE 11): required fields and
+# the cross-check tolerance against the registry triple. The two
+# sides time the SAME requests via independent pipes (span stamps vs
+# registry counters), so they agree closely; the tolerance absorbs
+# rejected-request asymmetry and CPU-smoke scheduling noise.
+SERVE_SPAN_FIELDS = (
+    "span_queued_frac", "span_batch_wait_frac", "span_device_frac",
+)
+SPAN_SPLIT_TOL = 0.15
+
+# paddle_tpu/obs/ modules the obs lint additionally REQUIRES to exist
+REQUIRED_OBS_MODULES = (
+    "metrics.py", "timeline.py", "tracing.py", "flight_recorder.py",
+)
+
+BUNDLE_SCHEMA = "paddle-tpu-flight-bundle/v1"
+BUNDLE_REQUIRED_FIELDS = (
+    "schema", "reason", "ts", "pid", "seq", "events", "metrics",
+)
+SPAN_EVENT_FIELDS = (
+    "name", "trace_id", "span_id", "parent_id", "ts", "dur_s",
+    "status",
 )
 
 
@@ -176,6 +213,12 @@ def check_obs_imports(repo_dir: str) -> list:
     obs_dir = os.path.join(repo_dir, "paddle_tpu", "obs")
     if not os.path.isdir(obs_dir):
         return [f"{obs_dir}: missing — the telemetry package is gone"]
+    for required in REQUIRED_OBS_MODULES:
+        if not os.path.exists(os.path.join(obs_dir, required)):
+            violations.append(
+                f"paddle_tpu/obs/{required}: missing — a required "
+                f"observability module was deleted"
+            )
 
     def walk_module_scope(node):
         """Yield nodes reachable at import time (skip function
@@ -261,6 +304,95 @@ def check_compare(stdout_path: str, record_path: str) -> list:
                     f"{missing} — north-star rows must attribute "
                     f"their step time (data-wait / host / device)"
                 )
+        if m == "serve_loadtest" and "error" not in d \
+                and "skipped" not in d:
+            violations.extend(_check_serve_span_split(d))
+    return violations
+
+
+def _check_serve_span_split(row: dict) -> list:
+    """serve_loadtest rows must carry the span-derived critical-path
+    split and it must reconcile with the registry triple (ISSUE 11):
+    span queued + batch-wait vs the registry's data_wait (both are
+    "before the program ran"), span device vs the registry's device
+    share."""
+    missing = [f for f in SERVE_SPAN_FIELDS if f not in row]
+    if missing:
+        return [
+            f"row 'serve_loadtest': missing span field(s) {missing} "
+            f"— the row must carry the span-derived critical-path "
+            f"split beside the registry triple"
+        ]
+    violations = []
+    span_wait = row["span_queued_frac"] + row["span_batch_wait_frac"]
+    reg_wait = row.get("data_wait_frac")
+    if reg_wait is not None and abs(span_wait - reg_wait) \
+            > SPAN_SPLIT_TOL:
+        violations.append(
+            f"row 'serve_loadtest': span wait "
+            f"(queued+batch_wait={span_wait:.4f}) disagrees with the "
+            f"registry data_wait_frac={reg_wait:.4f} beyond "
+            f"tol={SPAN_SPLIT_TOL} — one of the two measurement "
+            f"paths is broken"
+        )
+    reg_dev = row.get("device_frac")
+    if reg_dev is not None and abs(row["span_device_frac"] - reg_dev) \
+            > SPAN_SPLIT_TOL:
+        violations.append(
+            f"row 'serve_loadtest': span_device_frac="
+            f"{row['span_device_frac']:.4f} disagrees with the "
+            f"registry device_frac={reg_dev:.4f} beyond "
+            f"tol={SPAN_SPLIT_TOL}"
+        )
+    return violations
+
+
+def check_bundle(path: str) -> list:
+    """Static schema lint for one flight-recorder bundle file."""
+    violations = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable bundle ({e})"]
+    if not isinstance(doc, dict):
+        return [f"{path}: bundle is not a JSON object"]
+    if doc.get("schema") != BUNDLE_SCHEMA:
+        violations.append(
+            f"{path}: schema {doc.get('schema')!r} != "
+            f"{BUNDLE_SCHEMA!r}"
+        )
+    for field in BUNDLE_REQUIRED_FIELDS:
+        if field not in doc:
+            violations.append(f"{path}: missing field {field!r}")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        violations.append(f"{path}: 'events' is not a list")
+        events = []
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "kind" not in ev:
+            violations.append(
+                f"{path}: events[{i}] has no 'kind'"
+            )
+            continue
+        if ev["kind"] == "span":
+            missing = [f for f in SPAN_EVENT_FIELDS if f not in ev]
+            if missing:
+                violations.append(
+                    f"{path}: events[{i}] span missing {missing}"
+                )
+            elif not (isinstance(ev["dur_s"], (int, float))
+                      and ev["dur_s"] >= 0):
+                violations.append(
+                    f"{path}: events[{i}] span dur_s "
+                    f"{ev['dur_s']!r} is not a non-negative number"
+                )
+    prof = doc.get("profile")
+    if prof is not None and (not isinstance(prof, dict)
+                             or "captured" not in prof):
+        violations.append(
+            f"{path}: 'profile' stanza malformed (needs 'captured')"
+        )
     return violations
 
 
@@ -275,6 +407,10 @@ def main(argv) -> int:
         )
     elif len(argv) == 4 and argv[1] == "compare":
         violations = check_compare(argv[2], argv[3])
+    elif len(argv) >= 3 and argv[1] == "bundle":
+        violations = []
+        for path in argv[2:]:
+            violations.extend(check_bundle(path))
     else:
         print(__doc__, file=sys.stderr)
         return 2
