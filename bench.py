@@ -250,6 +250,10 @@ def _timeline_fields(tl: dict) -> dict:
     }
 
 
+# `bench.py PATTERN --capture DIR`: rows with a capture path (beam
+# decode) write profiler + HLO captures here for trace_attribution
+_CAPTURE_DIR = [None]
+
 # metrics whose value is repeated on the final summary line
 NORTH_STARS = (
     "resnet50_train_imgs_per_s",
@@ -465,15 +469,65 @@ def bench_image(model, bs):
     shape = (32, 32, 3) if model == "smallnet" else (224, 224, 3)
     classes = 10 if model == "smallnet" else 1000
     conf = factory(image_shape=shape, num_classes=classes)
-    # smallnet steps sit at the dispatch floor: run each window's steps
-    # inside one jitted scan so the row measures the model
-    kw = (
-        {"iters": 40, "windows": 5, "fused": True}
-        if model == "smallnet"
-        else {}
-    )
-    ms = _time_train(conf, _image_feed(bs, shape, classes), **kw)
+    if model == "smallnet":
+        # smallnet steps sit at the dispatch floor (~2-10 ms through
+        # the tunnel): the row drives the PRODUCTION trainer option
+        # (SGD steps_per_dispatch, ROADMAP 5d) both ways and A/Bs them
+        return _bench_pipelined_trainer(
+            conf, _image_feed(bs, shape, classes)
+        )
+    ms = _time_train(conf, _image_feed(bs, shape, classes))
     return {"value": round(ms, 3), "unit": "ms/batch"}
+
+
+def _bench_pipelined_trainer(conf, feed, inner=20, opt_conf=None):
+    """Small-model A/B through the real trainer (ROADMAP 5d: the
+    scan-of-steps bench trick is now `SGD(steps_per_dispatch=N)`, and
+    the row measures THAT option, not a bench-only formulation): one
+    SGD steps per-batch (N=1, one program dispatch per batch — pays
+    the tunnel's dispatch floor every step), the other dispatches
+    `inner` batches as one scan-of-steps program. Windows interleave;
+    headline = the better arm's ms/step; `pipeline_speedup` =
+    per_dispatch_ms / pipelined_ms (>1: the trainer option wins —
+    small-model rows now measure the chip, not the tunnel)."""
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.trainer.trainer import SGD
+
+    opt = opt_conf or OptimizationConf(
+        learning_method="momentum", learning_rate=0.001, momentum=0.9
+    )
+    seq_t = SGD(conf, opt, seed=0, steps_per_dispatch=1)
+    pip_t = SGD(conf, opt, seed=0, steps_per_dispatch=inner)
+    feeds = [feed] * inner
+
+    def seq_window():
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            seq_t.run_step(feed)
+        return (time.perf_counter() - t0) / inner * 1e3
+
+    def pip_window():
+        t0 = time.perf_counter()
+        pip_t.run_steps(feeds)
+        return (time.perf_counter() - t0) / inner * 1e3
+
+    seq_window()  # compile + warm both programs
+    pip_window()
+    best = _interleaved_best(
+        {"per_dispatch": seq_window, "pipelined": pip_window},
+        rounds=5,
+    )
+    ms = min(best.values())
+    return {
+        "value": round(ms, 3),
+        "unit": "ms/batch",
+        "ms_per_dispatch": round(best["per_dispatch"], 3),
+        "ms_pipelined": round(best["pipelined"], 3),
+        "pipeline_speedup": round(
+            best["per_dispatch"] / best["pipelined"], 3
+        ),
+        "steps_per_dispatch": inner,
+    }
 
 
 def bench_lstm(bs, hidden):
@@ -516,61 +570,133 @@ def bench_lstm(bs, hidden):
     }
 
 
-def bench_longctx(bs=4, t=4096, d=512, heads=8, layers=2, classes=512):
-    """Long-context causal self-attention training throughput — the
-    capability the 2017 reference lacks entirely (SURVEY §5 'no ring
-    attention / CP'; its sequence story is padding-free batching).
-    Single-chip arm of the long-sequence design whose multi-chip
-    ring/Ulysses shardings the driver gate witnesses
-    (__graft_entry__.dryrun_multichip): embedding -> N causal MHA
-    blocks with residual fc -> per-token classification. Tokens/s
-    counts B*T per optimizer step."""
+def longctx_conf(t, d=512, heads=8, layers=2, classes=512,
+                 attn_impl="dense", seq_parallel="none",
+                 vocab=32000):
+    """The long-context self-attention model every longctx row (single
+    chip AND the T>=32k ring/Ulysses multichip rows) measures:
+    embedding -> N causal MHA blocks with residual fc -> per-token
+    classification. One builder so the A/B arms differ ONLY in
+    attn_impl / seq_parallel."""
     from paddle_tpu import dsl
-    from paddle_tpu.core.arg import id_arg
-    from paddle_tpu.core.config import OptimizationConf
 
     with dsl.model() as m:
         ids = dsl.data("ids", dim=(), is_ids=True, is_seq=True)
         lbl = dsl.data("label", dim=(), is_ids=True, is_seq=True)
-        x = dsl.embedding(ids, size=d, vocab_size=32000)
+        x = dsl.embedding(ids, size=d, vocab_size=vocab)
         for _ in range(layers):
             att = dsl._add(
                 "multi_head_attention", [x], size=d,
-                num_heads=heads, causal=True, seq_parallel="none",
+                num_heads=heads, causal=True,
+                seq_parallel=seq_parallel, attn_impl=attn_impl,
             )
             x = dsl.addto(att, dsl.fc(att, size=d, act="relu"))
         out = dsl.fc(x, size=classes, act="")
         dsl.classification_cost(out, lbl)
-    conf = m.conf
-    rng = np.random.default_rng(0)
+    return m.conf
+
+
+def longctx_feed(bs, t, classes=512, vocab=32000, seed=0):
+    from paddle_tpu.core.arg import id_arg
+
+    rng = np.random.default_rng(seed)
     lens = np.full((bs,), t, np.int32)
-    feed = {
+    return {
         "ids": id_arg(
-            rng.integers(0, 32000, (bs, t)).astype(np.int32), lens
+            rng.integers(0, vocab, (bs, t)).astype(np.int32), lens
         ),
         "label": id_arg(
             rng.integers(0, classes, (bs, t)).astype(np.int32), lens
         ),
     }
-    opt = OptimizationConf(learning_method="adam", learning_rate=1e-3)
-    ms = _time_train(conf, feed, opt, iters=10, warmup=10)
-    toks = bs * t / (ms / 1e3)
-    # model FLOPs/step (fwd+bwd=3x fwd): per layer QKVO projections
-    # 4 matmuls * 2*B*T*D^2 + attention 4*B*T^2*D (QK^T and attn@V,
-    # 2*B*T^2*D each; causal halves the useful work but the dense
-    # kernel computes the full square) + mlp 2*B*T*D^2, plus the
-    # output head 2*B*T*D*classes
-    fwd = layers * (
+
+
+def _longctx_flops_fwd(bs, t, d, heads, layers, classes):
+    # model FLOPs (2/MAC): per layer QKVO projections 4 matmuls *
+    # 2*B*T*D^2 + attention 4*B*T^2*D (QK^T and attn@V, 2*B*T^2*D
+    # each; causal halves the useful work but both impls compute the
+    # full square — the same convention for both A/B arms) + mlp
+    # 2*B*T*D^2, plus the output head 2*B*T*D*classes
+    return layers * (
         4 * 2 * bs * t * d * d + 2 * 2 * bs * t * t * d
         + 2 * bs * t * d * d
     ) + 2 * bs * t * d * classes
+
+
+def bench_longctx(bs=4, t=4096, d=512, heads=8, layers=2, classes=512):
+    """Long-context causal self-attention training throughput — the
+    capability the 2017 reference lacks entirely (SURVEY §5 'no ring
+    attention / CP'; its sequence story is padding-free batching).
+    Tokens/s counts B*T per optimizer step.
+
+    The row is an interleaved dense-vs-flash A/B (ISSUE 12 / ROADMAP
+    1): both attn_impl lowerings of the SAME model are warmed, their
+    timing windows round-robined, and the row reports the better arm
+    as the headline plus `fused_speedup` = dense_ms / flash_ms — the
+    same A/B discipline as the resnet/nmt rows (only interleaved
+    ratios are trustworthy on the shared tunnel). Analytic HBM-byte
+    accounting (parallel/ring.attention_hbm_bytes) states the byte
+    reduction the flash arm is EXPECTED to deliver — dense streams
+    O(T^2) score bytes, flash O(T) — so the measured ratio argues
+    against a stated expectation; the committed HLO captures
+    (tools/traces/longctx_*.attrib.json) prove the same fact
+    per-instruction. If one arm cannot build, the row carries
+    `ab_skipped` naming why (tools/check_bench_record.py enforces one
+    of the two fields)."""
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.parallel.ring import attention_hbm_bytes
+
+    feed = longctx_feed(bs, t, classes)
+    opt = OptimizationConf(learning_method="adam", learning_rate=1e-3)
+    arms, errors = {}, {}
+    for impl in ("dense", "flash"):
+        try:
+            conf = longctx_conf(
+                t, d, heads, layers, classes, attn_impl=impl
+            )
+            warmup_fn, window_fn = _build_arm(conf, feed, opt, iters=10)
+            warmup_fn(10)
+            arms[impl] = window_fn
+        except Exception as e:  # an unbuildable arm skips the A/B,
+            errors[impl] = f"{type(e).__name__}: {e}"[:160]  # not the row
+    if not arms:
+        raise RuntimeError(f"both attention arms failed: {errors}")
+    best = _interleaved_best(arms, rounds=3)
+    ms = min(best.values())
+    winner = min(best, key=best.get)
+    toks = bs * t / (ms / 1e3)
+    fwd = _longctx_flops_fwd(bs, t, d, heads, layers, classes)
     mfu = 3 * fwd * (1e3 / ms) / TPU_PEAK_FLOPS
-    return {
+    hd = d // heads
+    bytes_dense = layers * attention_hbm_bytes(bs, t, t, heads, hd,
+                                               "dense")
+    bytes_flash = layers * attention_hbm_bytes(bs, t, t, heads, hd,
+                                               "flash")
+    out = {
+        **_timeline_fields(arms[winner].timeline),
         "value": round(toks, 1),
         "unit": "tokens/s/chip (causal self-attention, T=%d)" % t,
         "ms_per_step": round(ms, 2),
         "analytic_mfu": round(mfu, 3),
+        "attn_impl_winner": winner,
+        # analytic attention-core HBM bytes (fwd+bwd, per step):
+        # the byte-removal expectation the A/B ratio argues against
+        "attn_hbm_bytes_dense": bytes_dense,
+        "attn_hbm_bytes_flash": bytes_flash,
+        "attn_byte_reduction_expected": round(
+            bytes_dense / bytes_flash, 1
+        ),
     }
+    for impl, v in best.items():
+        out[f"ms_{impl}"] = round(v, 3)
+    if len(arms) == 2:
+        out["fused_speedup"] = round(best["dense"] / best["flash"], 3)
+    else:
+        out["ab_skipped"] = (
+            f"{next(iter(errors))} arm failed: "
+            f"{next(iter(errors.values()))}"
+        )
+    return out
 
 
 def bench_lstm_fused_vs_scan(bs=128, hidden=256):
@@ -868,7 +994,37 @@ def _nmt_train_flops_per_batch(bs, t, hidden, vocab, emb):
     return 3 * bs * t * (enc + att + dec + proj)
 
 
-def bench_nmt(bs=256, t=32, hidden=512, vocab=30000, emb=512):
+def _mha_xattn_conf(vocab, emb, d, heads, classes, attn_impl):
+    """The dense-vs-flash probe model for the NMT T=128 row: target
+    embeddings cross-attending the encoder sequence through the
+    multi_head_attention layer (the byte-story analogue of the NMT
+    attention at the row's exact B/T/hidden shape). The NMT model's
+    own additive attention materializes [B, T] scores per decoder
+    step — there is no [T, T] matrix to remove — so the row's flash
+    A/B measures this probe, interleaved with the NMT arms; the small
+    classification head keeps the probe attention-dominated instead
+    of softmax-dominated."""
+    from paddle_tpu import dsl
+
+    with dsl.model() as m:
+        src = dsl.data("src", dim=(), is_ids=True, is_seq=True)
+        trg = dsl.data("trg_in", dim=(), is_ids=True, is_seq=True)
+        lbl = dsl.data("label", dim=(), is_ids=True, is_seq=True)
+        enc = dsl.embedding(src, size=emb, vocab_size=vocab,
+                            name="xenc_emb")
+        q = dsl.embedding(trg, size=emb, vocab_size=vocab,
+                          name="xq_emb")
+        att = dsl._add(
+            "multi_head_attention", [q, enc], size=d,
+            num_heads=heads, causal=False, attn_impl=attn_impl,
+        )
+        out = dsl.fc(att, size=classes, act="")
+        dsl.classification_cost(out, lbl)
+    return m.conf
+
+
+def bench_nmt(bs=256, t=32, hidden=512, vocab=30000, emb=512,
+              flash_ab=False):
     """Seq2seq NMT with attention (north star). Tokens/s counts target
     tokens (the decoder steps driving the attention + softmax work).
     Carries `mfu` from the analytic model-FLOPs convention
@@ -878,7 +1034,15 @@ def bench_nmt(bs=256, t=32, hidden=512, vocab=30000, emb=512):
     projections, merged prev-GEMMs) — and reports the better one as
     the headline with both visible (the resnet-row A/B discipline;
     which wins depends on chip health: under throttle per-op compute
-    dominates and the arms converge)."""
+    dominates and the arms converge).
+
+    `flash_ab` (the T=128 row): two more interleaved arms run the MHA
+    cross-attention probe (_mha_xattn_conf) at the row's exact shape,
+    dense vs flash, and `fused_speedup` on THAT row is their ratio —
+    the dense-vs-flash A/B ISSUE 12 requires; the decoder-lowering
+    ratio moves to `fused_decoder_speedup`. The probe arms never
+    touch the headline value (a different model must not redefine the
+    row's history)."""
     from paddle_tpu.core.arg import id_arg
     from paddle_tpu.core.config import OptimizationConf
     from paddle_tpu.models import seq2seq_attention
@@ -914,13 +1078,42 @@ def bench_nmt(bs=256, t=32, hidden=512, vocab=30000, emb=512):
     fw, ffn = _build_arm_fused(conf, feed, opt, inner=10)
     fw(2)
     arms["plain_scanned"] = ffn
+    ab_err = None
+    if flash_ab:
+        probe_classes = 512
+        rng2 = np.random.default_rng(1)
+        probe_feed = {
+            "src": feed["src"],
+            "trg_in": feed["trg_in"],
+            "label": id_arg(
+                rng2.integers(0, probe_classes, (bs, t)).astype(
+                    np.int32
+                ),
+                lens,
+            ),
+        }
+        try:
+            for impl in ("dense", "flash"):
+                pconf = _mha_xattn_conf(
+                    vocab, emb, hidden, 8, probe_classes, impl
+                )
+                pw, pf = _build_arm(pconf, probe_feed, opt, iters=10)
+                pw(10)
+                arms[f"mha_{impl}"] = pf
+        except Exception as e:
+            ab_err = f"{type(e).__name__}: {e}"[:160]
+            arms.pop("mha_dense", None)
+            arms.pop("mha_flash", None)
     best = _interleaved_best(arms, rounds=3)
-    ms = min(best.values())
-    winner = min(best, key=best.get)
+    # probe arms measure the flash A/B, never the row's headline
+    nmt_best = {k: v for k, v in best.items()
+                if not k.startswith("mha_")}
+    ms = min(nmt_best.values())
+    winner = min(nmt_best, key=nmt_best.get)
     tok_s = bs * t / (ms / 1e3)
     flops = _nmt_train_flops_per_batch(bs, t, hidden, vocab, emb)
     mfu = flops / (ms / 1e3) / TPU_PEAK_FLOPS
-    return {
+    out = {
         **_timeline_fields(arms[winner].timeline),
         "value": round(tok_s, 0),
         "unit": "tokens/s/chip",
@@ -932,19 +1125,60 @@ def bench_nmt(bs=256, t=32, hidden=512, vocab=30000, emb=512):
         "ms_plain": round(best["plain"], 3),
         "ms_fused": round(best["fused"], 3),
         "ms_plain_scanned": round(best["plain_scanned"], 3),
-        "fused_speedup": round(best["plain"] / best["fused"], 3),
     }
+    decoder_ratio = round(best["plain"] / best["fused"], 3)
+    if not flash_ab:
+        out["fused_speedup"] = decoder_ratio
+        return out
+    # the T=128 row: fused_speedup IS the dense-vs-flash ratio
+    out["fused_decoder_speedup"] = decoder_ratio
+    if "mha_flash" in best:
+        out["ms_mha_dense"] = round(best["mha_dense"], 3)
+        out["ms_mha_flash"] = round(best["mha_flash"], 3)
+        out["fused_speedup"] = round(
+            best["mha_dense"] / best["mha_flash"], 3
+        )
+        out["ab"] = "mha_crossattn_dense_vs_flash"
+    else:
+        out["ab_skipped"] = f"mha probe arm failed: {ab_err}"
+    return out
+
+
+def write_decode_hlo(dec, params, statics, boots, path):
+    """Dump the compiled decode program's HLO text (gzipped) for
+    tools/trace_attribution.py's HLO-capture mode — the per-iteration
+    byte accounting behind the beam-decode floor analysis (ROADMAP
+    5a / PERF.md round 8). Works on any backend: compilation needs no
+    device execution."""
+    import gzip
+
+    static_feed, init_carry_mem, b = dec.prepare(statics, boots)
+    run = dec._decode_program()
+    txt = run.lower(
+        params, static_feed, init_carry_mem, b
+    ).compile().as_text()
+    with gzip.open(path, "wt") as f:
+        f.write(txt)
+    return path
 
 
 def bench_beam_decode(bs=32, t_src=32, beam=4, max_len=32, hidden=512,
-                      vocab=30000, emb=512):
+                      vocab=30000, emb=512, capture_dir=None):
     """Beam-search generation on the NMT model (VERDICT r3 next #3;
     reference api/SequenceGenerator.cpp + RecurrentGradientMachine.h:307
     generation mode). value = decoded target tokens/s (best beam),
     beam=4, fully jitted while-loop; `hooks_on_tok_s` measures the same
     decode with a host-side adjust callback registered every step (the
     registerBeamSearchControlCallbacks surface via pure_callback), so
-    the host-hook tax is visible."""
+    the host-hook tax is visible.
+
+    `capture_dir` (or `bench.py ... --capture DIR`): after measuring,
+    (a) re-runs one hooks-off decode inside jax.profiler.trace(DIR) —
+    on TPU that XPlane capture is what tools/trace_attribution.py
+    consumes for the on-device decode verdict (ROADMAP 5a) — and
+    (b) writes the compiled decode program's HLO to
+    DIR/nmt_beam4_decode.hlo.txt.gz for the backend-independent byte
+    accounting. The row then carries `capture: DIR`."""
     import jax
 
     from paddle_tpu.beam_search import BeamHooks
@@ -996,9 +1230,9 @@ def bench_beam_decode(bs=32, t_src=32, beam=4, max_len=32, hidden=512,
             t0 = time.perf_counter()
             once()
             best = min(best, time.perf_counter() - t0)
-        return best, timeline
+        return best, timeline, dec, once
 
-    t_off, tl = run_decoder(None)
+    t_off, tl, dec_off, once_off = run_decoder(None)
     tok_s = bs * max_len / t_off
     out = {
         "value": round(tok_s, 0),
@@ -1009,8 +1243,26 @@ def bench_beam_decode(bs=32, t_src=32, beam=4, max_len=32, hidden=512,
         "all_beams_tok_s": round(bs * beam * max_len / t_off, 0),
         **_timeline_fields(tl),
     }
+    capture_dir = capture_dir or _CAPTURE_DIR[0]
+    if capture_dir:
+        os.makedirs(capture_dir, exist_ok=True)
+        from paddle_tpu.core import profiler
+
+        try:
+            with profiler.trace(capture_dir):
+                once_off()
+            write_decode_hlo(
+                dec_off, params, statics, boots,
+                os.path.join(capture_dir,
+                             "nmt_beam4_decode.hlo.txt.gz"),
+            )
+            out["capture"] = capture_dir
+        except Exception as e:
+            out["capture_error"] = f"{type(e).__name__}: {e}"[:160]
     try:
-        t_on, _ = run_decoder(BeamHooks(adjust=lambda logp, t: logp))
+        t_on, _, _, _ = run_decoder(
+            BeamHooks(adjust=lambda logp, t: logp)
+        )
         out["hooks_on_tok_s"] = round(bs * max_len / t_on, 0)
         out["hooks_overhead_x"] = round(t_on / t_off, 2)
     except Exception as e:
@@ -1305,7 +1557,7 @@ def build_sweep():
         ("nmt_attention_train_tokens_per_s_bs512",
          lambda: bench_nmt(bs=512)),
         ("nmt_attention_train_tokens_per_s_t128",
-         lambda: bench_nmt(bs=64, t=128)),
+         lambda: bench_nmt(bs=64, t=128, flash_ab=True)),
         ("nmt_beam4_decode_tokens_per_s", bench_beam_decode),
         ("serve_loadtest", bench_serve_loadtest),
         ("ctr_sparse_step_v_independence", bench_sparse_ctr),
@@ -1377,6 +1629,16 @@ def _annotate_baseline(line, name):
 
 
 def main(argv):
+    # parse --capture BEFORE the --multichip dispatch: it must never
+    # leak through as a row-filter pattern
+    if "--capture" in argv:
+        i = argv.index("--capture")
+        if i + 1 >= len(argv):
+            print("bench.py: --capture needs a directory argument",
+                  file=sys.stderr)
+            return 2
+        _CAPTURE_DIR[0] = argv[i + 1]
+        argv = argv[:i] + argv[i + 2:]
     if "--multichip" in argv:
         from bench_multichip import mc_main
 

@@ -119,7 +119,14 @@ def _mesh_arm(conf, feed, opt_conf, mesh, iters):
         feed = jax.device_put(feed)
     key = jax.random.key(1)
 
+    # dispatch-vs-block split for the row's attribution triple (the
+    # same convention as bench.py::_build_arm: submissions are host
+    # work, the final scalar fetch is the device block; the feed is
+    # pre-staged so data_wait is truly 0)
+    timeline = {"data_s": 0.0, "dispatch_s": 0.0, "device_s": 0.0}
+
     def _run(n):
+        t0 = time.perf_counter()
         for _ in range(n):
             (
                 st["params"],
@@ -132,16 +139,23 @@ def _mesh_arm(conf, feed, opt_conf, mesh, iters):
                 st["i"], key,
             )
             st["i"] += 1
-        return float(loss)  # scalar fetch forces execution (tunnel)
+        t1 = time.perf_counter()
+        out = float(loss)  # scalar fetch forces execution (tunnel)
+        timeline["dispatch_s"] += t1 - t0
+        timeline["device_s"] += time.perf_counter() - t1
+        return out
 
     def warmup_fn(n):
         _run(n)
+        # drop the compile-laden warmup from the attribution fields
+        timeline["dispatch_s"] = timeline["device_s"] = 0.0
 
     def window_fn():
         t0 = time.perf_counter()
         _run(iters)
         return (time.perf_counter() - t0) / iters * 1e3
 
+    window_fn.timeline = timeline
     return warmup_fn, window_fn
 
 
@@ -242,6 +256,117 @@ def _bench_row(model, total_bs, n_dev, synthetic):
     out["ms_1dev_per_dev_batch"] = round(ms1, 3)
     out["speedup"] = round(ms1 * n_dev / ms, 2)
     out["scaling_efficiency"] = round(ms1 * n_dev / ms / n_dev, 3)
+    return out
+
+
+def _bench_longctx_sharded(mode, t, n_dev, synthetic, bs=1):
+    """The T>=32k long-context rows (ISSUE 12 tentpole: leave the
+    reference's 2017 world): the SAME longctx model as bench.py's
+    single-chip rows (bench.longctx_conf), but with the time dimension
+    sharded over the mesh `seq` axis — `mode` "ring" (K/V blocks
+    rotate over ICI, online softmax across AND inside ring steps:
+    score tiles capped at RING_BLOCK_K) or "ulysses" (all-to-all
+    seq->heads reshard with FLASH local attention, attn_impl="flash").
+    Dense single-chip attention cannot play at these shapes at all —
+    at T=32k the [B,H,T,T] scores alone mean ~69 GB of HBM traffic
+    per layer per FORWARD (4 round trips x 8 heads x T^2 x 2 bytes;
+    `attn_hbm_bytes_dense_equiv` states the fwd+bwd figure on the
+    row); the flash shardings stream O(T) score bytes per chip.
+
+    Real slice: measures tokens/s at the full T with the standard
+    data_wait/host/device attribution triple. Single-device hosts
+    re-exec onto the 8-virtual-device CPU mesh (synthetic=True): the
+    row shrinks to a shape smoke (scaled-down T, same code path
+    end-to-end — mesh, shard_map collectives, scan-of-blocks, bwd) so
+    the mode cannot rot in CI; no throughput claim."""
+    import jax
+
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.core.mesh import (
+        DATA_AXIS, SEQ_AXIS, make_mesh, set_mesh,
+    )
+    from paddle_tpu.parallel.ring import attention_hbm_bytes
+
+    from bench import (
+        TPU_PEAK_FLOPS,
+        _longctx_flops_fwd,
+        longctx_conf,
+        longctx_feed,
+    )
+
+    heads_adjusted = False
+    if synthetic:
+        # shape smoke: T scaled down but still sharded (T % n_dev == 0
+        # and heads % n_dev == 0 for the ulysses head split)
+        t_run, d, heads, layers, classes = 32 * n_dev, 64, n_dev, 1, 64
+        iters, warmup, windows = 2, 2, 1
+    else:
+        t_run, d, heads, layers, classes = t, 512, 8, 2, 512
+        iters, warmup, windows = 5, 5, 3
+        if mode == "ulysses" and heads % n_dev:
+            # the ulysses head split must divide the seq axis; record
+            # the substitution ON the row — a 16-head arm is not the
+            # 8-head model the ring row measures
+            if d % n_dev:
+                raise RuntimeError(
+                    f"ulysses needs heads divisible by the seq axis "
+                    f"({n_dev}) and d={d} % {n_dev} != 0 — pick a "
+                    f"mesh whose seq axis divides {d}"
+                )
+            heads = n_dev
+            heads_adjusted = True
+    conf = longctx_conf(
+        t_run, d, heads, layers, classes,
+        attn_impl="flash", seq_parallel=mode,
+    )
+    feed = longctx_feed(bs, t_run, classes)
+    mesh = make_mesh({DATA_AXIS: 1, SEQ_AXIS: n_dev})
+    set_mesh(mesh)
+    opt = OptimizationConf(learning_method="adam", learning_rate=1e-3)
+    try:
+        w, f = _mesh_arm(conf, feed, opt, mesh, iters)
+        w(warmup)
+        ms = min(f() for _ in range(windows))
+    finally:
+        set_mesh(make_mesh())  # later rows expect the default mesh
+    toks = bs * t_run / (ms / 1e3)
+    fwd = _longctx_flops_fwd(bs, t_run, d, heads, layers, classes)
+    hd = d // heads
+    from bench import _timeline_fields
+
+    out = {
+        **_timeline_fields(f.timeline),
+        "value": round(toks, 1),
+        "unit": "tokens/s (%s-sharded flash attention, T=%d)"
+                % (mode, t_run),
+        "ms_per_step": round(ms, 2),
+        "analytic_mfu_per_chip": round(
+            3 * fwd * (1e3 / ms) / TPU_PEAK_FLOPS / n_dev, 4
+        ),
+        "devices": n_dev,
+        "seq_len": t_run,
+        "seq_parallel": mode,
+        "attn_impl": "flash",
+        "heads": heads,
+        "batch": bs,
+        # what the 2017-semantics dense path WOULD stream through HBM
+        # in attention-score bytes at this shape — the reason these
+        # rows exist only as flash shardings
+        "attn_hbm_bytes_dense_equiv": layers * attention_hbm_bytes(
+            bs, t_run, t_run, heads, hd, "dense"
+        ),
+        "attn_hbm_bytes_flash": layers * attention_hbm_bytes(
+            bs, t_run, t_run, heads, hd, "flash"
+        ),
+    }
+    if heads_adjusted:
+        out["heads_adjusted"] = True  # NOT the ring rows' 8-head model
+    if synthetic:
+        out["synthetic"] = True
+        out["note"] = (
+            "host-CPU virtual mesh shape smoke at scaled-down T - "
+            "no throughput claim"
+        )
     return out
 
 
@@ -608,6 +733,26 @@ def mc_main(argv):
                                                    synthetic))
         for name, model, total in build_rows(n_dev)
     ]
+    # permanent long-context rows (ISSUE 12 / ROADMAP 1): ring- and
+    # Ulysses-sharded flash attention at T >= 32k — the sequence
+    # lengths the 2017 reference (and our own dense path) cannot
+    # reach; tools/check_bench_record.py pins the row names so the
+    # matrix cannot silently drop them
+    rows.append((
+        f"mc_longctx_ring_t32768_sp{n_dev}",
+        lambda: _bench_longctx_sharded("ring", 32768, n_dev,
+                                       synthetic),
+    ))
+    rows.append((
+        f"mc_longctx_ulysses_t32768_sp{n_dev}",
+        lambda: _bench_longctx_sharded("ulysses", 32768, n_dev,
+                                       synthetic),
+    ))
+    rows.append((
+        f"mc_longctx_ring_t131072_sp{n_dev}",
+        lambda: _bench_longctx_sharded("ring", 131072, n_dev,
+                                       synthetic),
+    ))
     # permanent elasticity rows (ROADMAP item 4 / ISSUE 9): checkpoint
     # stalls and preemption recovery are tracked like MFU, not assumed
     # away

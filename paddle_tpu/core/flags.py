@@ -40,6 +40,12 @@ _DEFAULTS: dict[str, Any] = {
     # (trainer/async_checkpoint.py)
     "checkpoint_mode": "sync",
     "start_pass": 0,
+    # multi-step pipelining (ROADMAP 5d): >1 runs N consecutive train
+    # steps as one jitted scan-of-steps dispatch (SGD steps_per_dispatch
+    # default; the bench-trick promoted to a trainer option). Short-step
+    # models stop paying the ~2-10 ms per-program dispatch floor per
+    # batch; events/evaluators/watchdog still see every batch.
+    "steps_per_dispatch": 1,
     # per-step timeline attribution (obs/timeline.py): fence the
     # device with block_until_ready every N steps so device_step is
     # measured end-to-end while steady-state dispatch stays async.

@@ -25,6 +25,22 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """`jax.shard_map` across jax versions: new runtimes expose it at
+    the top level with `check_vma`; this container's 0.4.37 only has
+    `jax.experimental.shard_map` with the older `check_rep` spelling.
+    One shim so every SPMD entry point (ring/ulysses attention,
+    sharded embedding, pipeline stages) runs on both."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_rep=check_vma)
+
 _current_mesh: Optional[Mesh] = None
 
 

@@ -6,7 +6,14 @@ trainer_config_helpers/networks.py:1298 simple_attention — which is also
 reproduced, via models/text.py). attrs:
   num_heads     — head count (must divide size)
   causal        — bool, autoregressive mask
-  seq_parallel  — "none" (dense, GSPMD-friendly) | "ring" | "ulysses";
+  attn_impl     — "dense" (materializes [B,H,T,T] scores — the 2017
+                  reference path) | "flash" (no score matrix in HBM:
+                  Pallas TPU kernel, portable blocked lowering
+                  elsewhere — the measured long-context path, PERF.md
+                  round 8). Applies to seq_parallel "none" (whole
+                  attention) and "ulysses" (the local per-head-group
+                  attention); "ring" is flash-class by construction.
+  seq_parallel  — "none" (single-chip) | "ring" | "ulysses";
                   ring/ulysses shard the time dim over the mesh `seq`
                   axis (parallel/ring.py) and need the global mesh set via
                   paddle_tpu.core.mesh.set_mesh.
@@ -66,24 +73,37 @@ class MultiHeadAttentionLayer(Layer):
 
         from paddle_tpu.parallel import ring
 
+        def _get_mesh():
+            from paddle_tpu.core.mesh import get_mesh
+
+            return get_mesh()
+
+        impl = self.conf.attrs.get("attn_impl", "dense")
         if mode == "none":
-            # attn_impl "flash" uses the Pallas TPU kernel (no
-            # materialized [B,H,T,T] scores) — the long-context lever;
-            # "dense" stays the default (runs on every backend)
-            if self.conf.attrs.get("attn_impl", "dense") == "flash":
+            # attn_impl "flash" never materializes the [B,H,T,T]
+            # scores (Pallas TPU kernel; portable blocked lowering on
+            # other backends) — the long-context lever; "dense" stays
+            # the default (the 2017-semantics reference path)
+            if impl == "flash":
                 out = ring.flash_dense_attention(
-                    q, k, v, causal=causal, kv_len=kva.seq_lens
+                    q, k, v, causal=causal, kv_len=kva.seq_lens,
+                    q_len=qa.seq_lens if qa is not kva else None,
                 )
             else:
                 out = ring.dense_attention(
                     q, k, v, causal=causal, kv_len=kva.seq_lens
                 )
+        elif mode == "ring":
+            # ring attention IS flash-class already (online softmax,
+            # no [T,T] scores) — attn_impl does not apply
+            out = ring.ring_attention(
+                q, k, v, _get_mesh(), causal=causal,
+                kv_lens=kva.seq_lens,
+            )
         else:
-            from paddle_tpu.core.mesh import get_mesh
-
-            fn = ring.ring_attention if mode == "ring" else ring.ulysses_attention
-            out = fn(
-                q, k, v, get_mesh(), causal=causal, kv_lens=kva.seq_lens
+            out = ring.ulysses_attention(
+                q, k, v, _get_mesh(), causal=causal,
+                kv_lens=kva.seq_lens, attn_impl=impl,
             )
         out = out.reshape(out.shape[0], out.shape[1], d)
         y = jnp.dot(out, params["wo"])

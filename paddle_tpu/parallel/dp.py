@@ -156,6 +156,35 @@ class TrainStep:
                 step, donate_argnums=(0, 1, 2) if donate else ()
             )
 
+        # multi-step pipelining (ROADMAP 5d): N consecutive steps as a
+        # lax.scan over the SAME step body inside ONE jitted dispatch,
+        # so short-step models amortize the per-program submission
+        # floor (~2-10 ms through the tunnel) N-fold. Per-step RNG is
+        # fold_in(step_key, global_step) — bit-identical to what the
+        # sequential loop derives, so N-step and 1-step training walk
+        # the same trajectory. Returns stacked per-step losses (or
+        # [n, 2] health vectors in watchdog mode) and stacked outs.
+        def multi_step(params, opt_state, state, feeds, step_i,
+                       step_key, lr_scale=None):
+            def body(carry, feed):
+                params, opt_state, state, i = carry
+                rng = jax.random.fold_in(step_key, i)
+                params, opt_state, state, loss, outs = step(
+                    params, opt_state, state, feed, i, rng,
+                    lr_scale=lr_scale,
+                )
+                return (params, opt_state, state, i + 1), (loss, outs)
+
+            carry = (params, opt_state, state, jnp.int32(step_i))
+            (params, opt_state, state, _), (losses, outs) = jax.lax.scan(
+                body, carry, feeds
+            )
+            return params, opt_state, state, losses, outs
+
+        self._multi = jax.jit(
+            multi_step, donate_argnums=(0, 1, 2) if donate else ()
+        )
+
     def place(self, params, opt_state, state):
         """Place params/opt-state/state on the mesh per their shardings."""
         if self.mesh is None:
@@ -175,6 +204,31 @@ class TrainStep:
             lambda x: jax.device_put(x, self._rep), state
         )
         return p, o, s
+
+    def multi(self, params, opt_state, state, feeds, step_i, step_key,
+              lr_scale=None):
+        """Run n = leading-dim(feeds) consecutive steps in ONE
+        dispatch. `feeds` is the per-step feed pytree stacked on a new
+        leading axis (jnp.stack over the batch feeds); `step_key` is
+        the TRAINER's step key (per-step rngs are derived inside, so
+        the trajectory matches n sequential __call__s exactly).
+        Returns (params, opt_state, state, losses, outs) with losses
+        [n] (or [n, 2] health vectors in watchdog mode) and outs
+        leaves stacked [n, ...]. jax.jit retraces per distinct n —
+        use one or two stable chunk sizes."""
+        if self.mesh is not None:
+            sh = NamedSharding(self.mesh, P(None, DATA_AXIS))
+            feeds = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sh) if x is not None else None,
+                feeds,
+            )
+        if self.watchdog:
+            return self._multi(
+                params, opt_state, state, feeds, step_i, step_key,
+                1.0 if lr_scale is None else float(lr_scale),
+            )
+        return self._multi(params, opt_state, state, feeds, step_i,
+                           step_key)
 
     def __call__(self, params, opt_state, state, feed, step_i, rng,
                  lr_scale=None):

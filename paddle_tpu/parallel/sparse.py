@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.mesh import MODEL_AXIS
+from paddle_tpu.core.mesh import shard_map as _shard_map
 
 # Per-process cache-busting constant for layout-pinned programs,
 # embedded by adding it to the table's SCRATCH row (index V — a
@@ -76,7 +77,7 @@ def embedding_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS):
         rows = jnp.where(ok[..., None], rows, 0)
         return lax.psum(rows, axis)
 
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis, None), P()),

@@ -110,18 +110,43 @@ class SGD:
         seed: int = 0,
         params: Optional[dict] = None,
         watchdog=None,
+        steps_per_dispatch: Optional[int] = None,
     ):
         """`watchdog`: None = follow the `watchdog` flag (default on);
         False disables; True or a `wdg.WatchdogConfig` enables with
         the given knobs. Enabled, the train step skips non-finite
         updates on device and `train` runs the escalation ladder
         (skip -> LR backoff -> rollback -> abort) plus SIGTERM-safe
-        preemption (trainer/watchdog.py)."""
+        preemption (trainer/watchdog.py).
+
+        `steps_per_dispatch`: None = the flag (default 1). N > 1 runs
+        N consecutive batches as ONE jitted scan-of-steps dispatch
+        (ROADMAP 5d: the bench trick promoted to a trainer option) —
+        short-step models amortize the per-program dispatch floor
+        N-fold while walking the bit-identical training trajectory
+        (per-step RNG is derived inside the scan exactly as the
+        sequential loop derives it). Events, evaluators and the
+        watchdog still observe every batch; the differences are
+        chunk-granular: LR backoff takes effect on the NEXT chunk,
+        preemption checkpoints at chunk boundaries (un-dispatched
+        buffered batches are replayed by the deterministic reader —
+        still exactly-once), and per-step span trees are not emitted
+        (a scan dispatch has no per-batch host boundary to stamp)."""
         if watchdog is None:
             watchdog = bool(_flags.get_flag("watchdog"))
         if watchdog is True:
             watchdog = wdg.WatchdogConfig()
         self.watchdog_conf = watchdog or None
+        if steps_per_dispatch is None:
+            steps_per_dispatch = int(
+                _flags.get_flag("steps_per_dispatch") or 1
+            )
+        if steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{steps_per_dispatch}"
+            )
+        self.steps_per_dispatch = steps_per_dispatch
         self.last_watchdog_report: Optional[wdg.WatchdogReport] = None
         self._resume_skip_batches = 0
         self.net = Network(model_conf)
@@ -264,6 +289,51 @@ class SGD:
         timeline.step_done()
         return result
 
+    def run_steps(self, feeds, lr_scale: float = 1.0,
+                  timeline=None) -> tuple:
+        """Run len(feeds) consecutive steps in ONE jitted dispatch
+        (lax.scan over the train step — multi-step pipelining,
+        ROADMAP 5d). Returns (costs, finites, outs): per-batch cost
+        and finiteness lists in step order (one [n]-row device->host
+        fetch carries all of them), and the kept outputs with leaves
+        stacked [n, ...] (slice leaf[i] for batch i's evaluator view).
+        The per-step RNG/optimizer trajectory is identical to calling
+        run_step n times. All feeds in one call must share one shape
+        signature (they compile per distinct stacked shape)."""
+        n = len(feeds)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *feeds
+        )
+        t0 = time.perf_counter() if timeline is not None else 0.0
+        (
+            self.params,
+            self.opt_state,
+            self.state,
+            losses,
+            outs,
+        ) = self.step_fn.multi(
+            self.params, self.opt_state, self.state, stacked,
+            self.global_step, self.step_key, lr_scale=lr_scale,
+        )
+        self.global_step += n
+        t1 = time.perf_counter()
+        if timeline is not None:
+            timeline.add_dispatch(t1 - t0)
+        health = np.asarray(losses)  # the single host fetch
+        if self.step_fn.watchdog:
+            costs = [float(h) for h in health[:, 0]]
+            finites = [bool(h) for h in health[:, 1]]
+        else:
+            costs = [float(h) for h in health]
+            finites = [True] * n
+        if timeline is not None:
+            if timeline.fence_now(self.global_step):
+                jax.block_until_ready(self.params)
+            timeline.add_device(time.perf_counter() - t1)
+            for _ in range(n):
+                timeline.step_done()
+        return costs, finites, outs
+
     def train(
         self,
         reader: Callable,
@@ -340,8 +410,15 @@ class SGD:
                 evals = self._make_evaluators()
                 costs = []
                 batch_iter = iter(reader())
+                if self.steps_per_dispatch > 1:
+                    self._run_pass_pipelined(
+                        pass_id, start_pass, skip_batches, batch_iter,
+                        feeder, event_handler, evals, costs, tl, wd,
+                        guard, save_dir, ckpt_mode, log_period,
+                    )
+                    batch_iter = None  # drained
                 batch_id = -1
-                while True:
+                while batch_iter is not None:
                     t_data = time.perf_counter()
                     try:
                         raw = next(batch_iter)
@@ -497,9 +574,122 @@ class SGD:
                             "handling a training error"
                         )
 
+    def _run_pass_pipelined(self, pass_id, start_pass, skip_batches,
+                            batch_iter, feeder, event_handler, evals,
+                            costs, tl, wd, guard, save_dir, ckpt_mode,
+                            log_period):
+        """One pass with steps_per_dispatch > 1: batches are buffered
+        and dispatched as scan-of-steps chunks (run_steps). Per-batch
+        semantics preserved: BeginIteration fires when a batch is
+        collected (before its step runs), EndIteration/evaluators/
+        watchdog observe every batch in order after its chunk lands.
+        Chunk-granular differences are documented on __init__. A
+        shape-signature change (e.g. a ragged final reader batch)
+        flushes the buffer early, so mixed shapes cost one extra
+        compile, never an error."""
+        spd = self.steps_per_dispatch
+        buf = []  # (batch_id, feed)
+        sig = None
+        done_upto = skip_batches  # batches of this pass fully trained
+        stats_period = _flags.get_flag("show_parameter_stats_period")
+
+        def _sig(feed):
+            return (
+                jax.tree_util.tree_structure(feed),
+                tuple(
+                    (getattr(x, "shape", None), getattr(x, "dtype", None))
+                    for x in jax.tree_util.tree_leaves(feed)
+                ),
+            )
+
+        def _check_preempt():
+            if guard.preempted:
+                # buffered batches were never dispatched — drop them;
+                # the deterministic reader replays them after resume,
+                # so every batch still trains exactly once
+                self._preempt_flush(
+                    save_dir, ckpt_mode, pass_id, done_upto
+                )
+                raise wdg.Preempted(pass_id, done_upto, save_dir)
+
+        def flush():
+            nonlocal buf, sig, done_upto
+            if not buf:
+                return
+            with GLOBAL_STATS.timer("train_step"):
+                cs, fs, outs = self.run_steps(
+                    [f for _, f in buf],
+                    wd.lr_scale() if wd else 1.0, timeline=tl,
+                )
+            observe = True
+            for j, (bid, feed) in enumerate(buf):
+                cost, finite = cs[j], fs[j]
+                if finite:
+                    costs.append(cost)
+                    for ev in evals:
+                        ev.add_batch(
+                            jax.tree_util.tree_map(
+                                lambda x: x[j], outs
+                            ),
+                            feed,
+                        )
+                if wd is not None and observe:
+                    action = self._watchdog_act(
+                        wd, cost, finite, save_dir, ckpt_mode
+                    )
+                    if action == wdg.ROLLBACK:
+                        # the chunk's remaining batches trained on the
+                        # now-rolled-back trajectory; their costs are
+                        # discarded progress — stop feeding the ladder
+                        observe = False
+                results = (
+                    {ev.name: ev.result() for ev in evals}
+                    if (bid + 1) % log_period == 0 else {}
+                )
+                event_handler(EndIteration(pass_id, bid, cost, results))
+                if (bid + 1) % log_period == 0:
+                    log.info(
+                        "pass %d batch %d cost %.5f %s", pass_id, bid,
+                        float(np.mean(costs[-log_period:]))
+                        if costs else float("nan"),
+                        results,
+                    )
+                if stats_period and (bid + 1) % stats_period == 0:
+                    self._log_parameter_stats(pass_id, bid)
+            done_upto = buf[-1][0] + 1
+            buf, sig = [], None
+
+        batch_id = -1
+        while True:
+            _check_preempt()
+            t_data = time.perf_counter()
+            try:
+                raw = next(batch_iter)
+            except StopIteration:
+                break
+            batch_id += 1
+            if pass_id == start_pass and batch_id < skip_batches:
+                continue
+            dt_reader = time.perf_counter() - t_data
+            event_handler(BeginIteration(pass_id, batch_id))
+            t_feed = time.perf_counter()
+            feed = feeder(raw)
+            tl.add_data_wait(dt_reader + time.perf_counter() - t_feed)
+            fsig = _sig(feed)
+            if buf and fsig != sig:
+                flush()
+            buf.append((batch_id, feed))
+            sig = fsig
+            if len(buf) >= spd:
+                flush()
+        flush()
+        _check_preempt()
+
     def _watchdog_act(self, wd, cost, finite, save_dir, ckpt_mode):
         """Run the ladder on one batch's (cost, finite) verdict;
-        perform the rollback here (the trainer owns params/resume)."""
+        perform the rollback here (the trainer owns params/resume);
+        returns the ladder's action (the pipelined loop stops
+        observing a chunk after ROLLBACK)."""
         action = wd.observe(cost, finite, self.global_step - 1)
         if action == wdg.ROLLBACK:
             target = wd.good_pass
@@ -535,6 +725,7 @@ class SGD:
         elif action == wdg.ABORT:
             log.error("watchdog abort: %s", wd.report.abort_reason)
             raise wdg.WatchdogAbort(wd.report)
+        return action
 
     def _preempt_flush(self, save_dir, ckpt_mode, pass_id,
                        batches_done):

@@ -161,13 +161,81 @@ def test_multichip_rows_cover_reference_matrix():
 
 
 def test_longctx_row_smoke():
-    """The long-context bench rows (bench.bench_longctx) build and
-    measure at tiny shapes on the CPU mesh — the correctness smoke for
-    the single-chip long-sequence arm (the multi-chip ring/Ulysses
-    shardings are witnessed by the driver gate)."""
+    """The long-context bench row (bench.bench_longctx) builds and
+    measures BOTH attention arms at tiny shapes on the CPU mesh: the
+    interleaved dense-vs-flash A/B must produce `fused_speedup` (or an
+    explicit ab_skipped) plus the analytic HBM-byte accounting —
+    exactly what tools/check_bench_record.py enforces on the
+    committed record (ISSUE 12)."""
     import bench
 
     r = bench.bench_longctx(bs=2, t=64, d=32, heads=4, layers=1,
                             classes=16)
     assert r["value"] > 0 and r["ms_per_step"] > 0
     assert 0 <= r["analytic_mfu"] < 1
+    # both arms measured: the A/B ratio and its byte expectation
+    assert r["ms_dense"] > 0 and r["ms_flash"] > 0
+    assert r["fused_speedup"] == pytest.approx(
+        r["ms_dense"] / r["ms_flash"], rel=1e-3
+    )
+    assert r["attn_hbm_bytes_dense"] > r["attn_hbm_bytes_flash"]
+    assert r["attn_byte_reduction_expected"] > 1
+    # the triple rides the row like every measured permanent row
+    for f in ("data_wait_frac", "host_overhead_frac", "device_frac"):
+        assert f in r
+
+
+class TestLongctxSharded:
+    """CPU-mesh smokes for the T>=32k ring/Ulysses rows (ISSUE 12:
+    'each with a CPU-mesh smoke test so the mode cannot rot in CI').
+    In-process on the conftest 8-virtual-device mesh — the same
+    mesh + shard_map + scan-of-blocks + backward path the real rows
+    compile, at scaled-down T."""
+
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_sharded_row_smoke(self, mode):
+        sys.path.insert(0, REPO)
+        try:
+            import bench_multichip as mc
+        finally:
+            sys.path.remove(REPO)
+        r = mc._bench_longctx_sharded(mode, 32768, 8, synthetic=True)
+        assert r["value"] > 0 and r["ms_per_step"] > 0
+        assert r["synthetic"] is True
+        assert r["seq_parallel"] == mode
+        assert r["attn_impl"] == "flash"
+        assert r["seq_len"] % 8 == 0  # really sharded over the mesh
+        # the row states why dense cannot play at the real shape
+        assert r["attn_hbm_bytes_dense_equiv"] > \
+            r["attn_hbm_bytes_flash"]
+        for f in ("data_wait_frac", "host_overhead_frac",
+                  "device_frac"):
+            assert f in r
+
+
+@pytest.mark.slow
+def test_longctx_sharded_subprocess_rows(tmp_path):
+    """The full bench_multichip invocation path for the T>=32k rows —
+    single-device start, re-exec onto the forced CPU mesh, rows
+    emitted and recorded in the full-row artifact. slow-marked: the
+    in-process smokes above keep tier-1 coverage; this guards the
+    subprocess/re-exec plumbing on the full-suite tier."""
+    env = _mc_env(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "bench_multichip.py", "mc_longctx"],
+        capture_output=True, text=True, cwd=REPO, timeout=420,
+        env=env,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    by_name = {ln["metric"]: ln for ln in lines}
+    n = by_name["mc_config"]["devices"]
+    for row in ("mc_longctx_ring_t32768", "mc_longctx_ulysses_t32768",
+                "mc_longctx_ring_t131072"):
+        d = by_name[f"{row}_sp{n}"]
+        assert d.get("error") is None, d
+        assert d["value"] > 0
+    full = {json.loads(ln)["metric"]
+            for ln in open(env["BENCH_FULL_RECORD"]).read().splitlines()}
+    assert f"mc_longctx_ring_t32768_sp{n}" in full

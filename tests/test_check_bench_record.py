@@ -188,3 +188,68 @@ def test_cli_exit_codes(tmp_path):
         cwd=REPO, capture_output=True, text=True,
     )
     assert r.returncode == 1 and "missing" in r.stderr
+
+
+def test_compare_ab_tripwire(tmp_path):
+    """ISSUE 12: a measured longctx/NMT-T128 row must carry
+    `fused_speedup` (the interleaved dense-vs-flash verdict) or an
+    explicit `ab_skipped` reason — the A/B cannot silently drop."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    bare = {"metric": "longctx_selfattn_train_tokens_per_s_t4096",
+            "value": 1.0}
+    v = lint(bare)
+    assert v and "fused_speedup" in v[0] and "ab_skipped" in v[0]
+    assert lint(dict(bare, fused_speedup=3.1)) == []
+    assert lint(dict(bare, ab_skipped="flash arm failed: X")) == []
+    # t8192 + the nmt t128 row are covered too
+    for m in ("longctx_selfattn_train_tokens_per_s_t8192",
+              "nmt_attention_train_tokens_per_s_t128"):
+        nmt = {"metric": m, "value": 1.0}
+        if m.startswith("nmt_"):
+            # north-star rows also need the triple; isolate the A/B check
+            nmt.update(data_wait_frac=0.0, host_overhead_frac=0.1,
+                       device_frac=0.9)
+        assert any("fused_speedup" in x for x in lint(nmt))
+        assert lint(dict(nmt, fused_speedup=2.0)) == []
+    # errored/skipped rows are exempt (nothing was measured)
+    assert lint(dict(bare, error="RuntimeError: x", value=None)) == []
+
+
+def test_compare_mc_longctx_requires_triple(tmp_path):
+    """The T>=32k multichip rows carry the attribution triple like
+    every permanent row."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+    row = {"metric": "mc_longctx_ring_t32768_sp8", "value": 100.0}
+    stdout.write_text(json.dumps(row) + "\n")
+    record.write_text(json.dumps(row) + "\n")
+    v = cbr.check_compare(str(stdout), str(record))
+    assert v and "timeline" in v[0]
+    row.update(data_wait_frac=0.0, host_overhead_frac=0.4,
+               device_frac=0.6)
+    stdout.write_text(json.dumps(row) + "\n")
+    record.write_text(json.dumps(row) + "\n")
+    assert cbr.check_compare(str(stdout), str(record)) == []
+
+
+def test_static_pins_mc_longctx_rows(tmp_path):
+    """Deleting a T>=32k long-context row from bench_multichip.py is
+    a capability regression the static lint must catch."""
+    import shutil
+
+    assert cbr.check_static(REPO) == []
+    work = tmp_path / "repo"
+    work.mkdir()
+    shutil.copy(os.path.join(REPO, "bench.py"), work / "bench.py")
+    src = open(os.path.join(REPO, "bench_multichip.py")).read()
+    src = src.replace("mc_longctx_ulysses_t32768", "mc_gone")
+    (work / "bench_multichip.py").write_text(src)
+    v = cbr.check_static(str(work))
+    assert any("mc_longctx_ulysses_t32768" in x for x in v)

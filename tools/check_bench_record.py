@@ -15,10 +15,17 @@ both run by `tests/test_check_bench_record.py`:
   the same run, assert the multiset of stdout row ids ("metric" keys)
   is contained in the artifact. A stdout row missing from the record
   is exactly the regression 5b forbids.
-- the static pass also asserts the PERMANENT elasticity rows
-  (`mc_checkpoint_overhead`, `mc_preempt_recovery`) are still
-  registered in bench_multichip.py — deleting a permanent row is a
-  perf-record regression, not a cleanup.
+- the static pass also asserts the PERMANENT rows — the elasticity
+  rows (`mc_checkpoint_overhead`, `mc_preempt_recovery`) AND the
+  T>=32k long-context rows (`mc_longctx_ring_t32768`,
+  `mc_longctx_ulysses_t32768`, `mc_longctx_ring_t131072`, ISSUE 12) —
+  are still registered in bench_multichip.py: deleting a permanent
+  row is a perf-record regression, not a cleanup.
+- **A/B tripwire** (ISSUE 12, compare mode): the longctx t4096/t8192
+  and NMT-T128 rows must carry `fused_speedup` (the interleaved
+  dense-vs-flash ratio) or an explicit `ab_skipped` reason; the
+  mc_longctx rows must carry the timeline triple like every other
+  permanent row.
 - **timeline fields** (ISSUE 10): every north-star row must carry the
   per-step time-attribution triple `data_wait_frac` /
   `host_overhead_frac` / `device_frac`. compare mode checks the
@@ -61,8 +68,25 @@ from collections import Counter
 BENCH_FILES = ("bench.py", "bench_multichip.py")
 
 # permanent rows the multichip sweep must keep registering (ROADMAP 4 /
-# ISSUE 9: elasticity is measured, not assumed)
-REQUIRED_MC_ROWS = ("mc_checkpoint_overhead", "mc_preempt_recovery")
+# ISSUE 9: elasticity is measured, not assumed; ISSUE 12: the T>=32k
+# ring/Ulysses long-context rows are the measured proof the framework
+# left the reference's 2017 sequence lengths — deleting one is a
+# capability regression, not a cleanup)
+REQUIRED_MC_ROWS = (
+    "mc_checkpoint_overhead", "mc_preempt_recovery",
+    "mc_longctx_ring_t32768", "mc_longctx_ulysses_t32768",
+    "mc_longctx_ring_t131072",
+)
+
+# rows whose measured record must carry an interleaved A/B verdict
+# (ISSUE 12): `fused_speedup` (the dense-vs-flash ratio on the
+# longctx/NMT-T128 rows) or an explicit `ab_skipped` reason — the A/B
+# cannot silently drop from the record
+AB_ROWS = (
+    "longctx_selfattn_train_tokens_per_s_t4096",
+    "longctx_selfattn_train_tokens_per_s_t8192",
+    "nmt_attention_train_tokens_per_s_t128",
+)
 
 # north-star rows that must carry the timeline triple (ISSUE 10).
 # MUST equal bench.py's NORTH_STARS — static mode enforces the sync.
@@ -295,7 +319,8 @@ def check_compare(stdout_path: str, record_path: str) -> list:
     # attribution triple means an input-pipeline bubble could hide
     for d in printed_rows:
         m = d["metric"]
-        if (m in TIMELINE_ROWS or m.startswith("mc_preempt_recovery")) \
+        if (m in TIMELINE_ROWS or m.startswith("mc_preempt_recovery")
+                or m.startswith("mc_longctx_")) \
                 and "error" not in d and "skipped" not in d:
             missing = [f for f in TIMELINE_FIELDS if f not in d]
             if missing:
@@ -307,6 +332,16 @@ def check_compare(stdout_path: str, record_path: str) -> list:
         if m == "serve_loadtest" and "error" not in d \
                 and "skipped" not in d:
             violations.extend(_check_serve_span_split(d))
+        # A/B tripwire (ISSUE 12): a measured longctx/NMT-T128 row
+        # without a flash A/B verdict means the dense-vs-flash
+        # comparison silently dropped out of the record
+        if m in AB_ROWS and "error" not in d and "skipped" not in d \
+                and "fused_speedup" not in d and "ab_skipped" not in d:
+            violations.append(
+                f"row {m!r}: carries neither 'fused_speedup' nor an "
+                f"explicit 'ab_skipped' reason — the interleaved "
+                f"dense-vs-flash A/B must not silently drop"
+            )
     return violations
 
 

@@ -1,0 +1,165 @@
+"""Long-context attention capture tool (ISSUE 12 / PERF.md round 8):
+compile the longctx train step (the exact model bench.bench_longctx
+measures) with `attn_impl` dense AND flash, and write per-arm
+captures next to the committed traces:
+
+  tools/traces/longctx_t{T}_{impl}.hlo.txt.gz   compiled HLO module
+  tools/traces/longctx_t{T}_{impl}.report.json  shape + XLA cost
+                                                analysis (flops,
+                                                bytes accessed) +
+                                                optional measured ms
+
+`tools/trace_attribution.py CAPTURE.hlo.txt.gz` then produces the
+committed `*.attrib.json` byte attribution whose `attention` category
+proves the flash byte removal on the real compiled program — the
+no-TPU-needed half of the proof. On a TPU host, add `--trace-dir` to
+also capture an XPlane profile of the same step (the time half, same
+as tools/profile_resnet.py), and `--run` to measure step wall time on
+whatever backend this runs on.
+
+Compilation allocates no tensors, so the dense arm compiles at the
+full bench shape (B=4, T=4096) even on a laptop; `--run` at that
+shape needs the memory for the real [B,H,T,T] scores — that being
+prohibitive is the point.
+
+Usage: python tools/profile_longctx.py [--t 4096] [--bs 4]
+       [--impls dense,flash] [--out-dir tools/traces] [--run]
+       [--trace-dir DIR]
+"""
+
+import argparse
+import gzip
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def build_step(conf, feed, seed=0):
+    """jitted fwd+bwd+update-free grad step (the byte-dominant part of
+    the train step; optimizer elementwise adds O(params) bytes
+    identically to both arms)."""
+    import jax
+
+    from paddle_tpu.network import Network
+
+    net = Network(conf)
+    params = net.init_params(jax.random.key(seed))
+    state = net.init_state()
+    key = jax.random.key(1)
+
+    def loss(p, f):
+        return net.loss_fn(p, f, state=state, rng=key, train=True)[0]
+
+    gf = jax.jit(lambda p, f: jax.grad(loss)(p, f))
+    return gf, params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--t", type=int, default=4096)
+    ap.add_argument("--bs", type=int, default=4)
+    ap.add_argument("--d", type=int, default=512)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--classes", type=int, default=512)
+    ap.add_argument("--impls", default="dense,flash")
+    ap.add_argument("--out-dir", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "traces"))
+    ap.add_argument("--run", action="store_true",
+                    help="also execute + time 3 steps per arm")
+    ap.add_argument("--trace-dir", default="",
+                    help="XPlane profiler capture dir (TPU hosts)")
+    args = ap.parse_args()
+
+    import jax
+
+    from paddle_tpu.core import flags as _flags
+
+    _flags.set_flag("matmul_precision", "bfloat16")
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+    from bench import longctx_conf, longctx_feed
+    from paddle_tpu.parallel.ring import attention_hbm_bytes
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    feed = longctx_feed(args.bs, args.t, args.classes)
+    for impl in args.impls.split(","):
+        conf = longctx_conf(
+            args.t, args.d, args.heads, args.layers, args.classes,
+            attn_impl=impl,
+        )
+        gf, params = build_step(conf, feed)
+        compiled = gf.lower(params, feed).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, list) else ca
+        try:
+            temp_bytes = compiled.memory_analysis().temp_size_in_bytes
+        except Exception:
+            temp_bytes = None  # not every backend reports it
+        stem = os.path.join(
+            args.out_dir, f"longctx_t{args.t}_{impl}"
+        )
+        with gzip.open(stem + ".hlo.txt.gz", "wt") as f:
+            f.write(compiled.as_text())
+        hd = args.d // args.heads
+        report = {
+            "model": "bench.longctx_conf (the longctx bench rows)",
+            "attn_impl": impl,
+            "batch_size": args.bs,
+            "seq_len": args.t,
+            "d_model": args.d,
+            "heads": args.heads,
+            "layers": args.layers,
+            "backend": jax.default_backend(),
+            "xla_flops": ca.get("flops", 0),
+            "xla_bytes_accessed": ca.get("bytes accessed", 0),
+            # peak temp memory: the reason dense T>=32k cannot exist
+            # on one chip at all (the [B,H,T,T] scores), independent
+            # of bandwidth
+            "hbm_temp_bytes": temp_bytes,
+            "analytic_attn_hbm_bytes": args.layers
+            * attention_hbm_bytes(
+                args.bs, args.t, args.t, args.heads, hd, impl
+            ),
+        }
+        if args.run:
+            import jax.numpy as jnp
+
+            dfeed = jax.device_put(feed)
+            r = gf(params, dfeed)
+            float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                r = gf(params, dfeed)
+                float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+                best = min(best, time.perf_counter() - t0)
+            report["fwd_bwd_ms"] = round(best * 1e3, 2)
+            report["tokens_per_s"] = round(
+                args.bs * args.t / best, 0
+            )
+        with open(stem + ".report.json", "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+        print(json.dumps({"impl": impl, **report}))
+        if args.trace_dir:
+            from paddle_tpu.core import profiler
+
+            tdir = os.path.join(args.trace_dir, impl)
+            dfeed = jax.device_put(feed)
+            with profiler.trace(tdir):
+                for _ in range(3):
+                    r = gf(params, dfeed)
+                float(jax.tree_util.tree_leaves(r)[0].ravel()[0])
+            print(f"trace written to {tdir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Attribute device time in an XLA trace to HLO categories.
+"""Attribute device time (or compiled-program bytes) to HLO categories.
 
 The tool behind ROADMAP item 2's attribution requirement: given a
 profiler capture (the Chrome-trace `trace.json.gz` that
@@ -7,12 +7,15 @@ profiler capture (the Chrome-trace `trace.json.gz` that
 committed `tools/traces/*.trace.json.gz` files), name where the
 device's wall time goes:
 
-- per-category device-time shares — **conv**, **gemm**,
-  **bn_elementwise** (BN statistics, activations, reductions, loop
-  fusions), **layout** (copies, transposes, dtype converts, HBM<->
-  scratch slices), **collective**, **infeed**, **other** — plus
-  **bubble** = wall minus device-busy (union of op intervals inside
-  the stepped window), the share no per-op table can show;
+- per-category device-time shares — **conv**, **gemm**, **attention**
+  (ops inside the attention named_scopes and Pallas/Mosaic
+  custom-call attention kernels — so flash time is attributed, not
+  lumped into "other"), **bn_elementwise** (BN statistics,
+  activations, reductions, loop fusions), **layout** (copies,
+  transposes, dtype converts, HBM<->scratch slices), **collective**,
+  **infeed**, **other** — plus **bubble** = wall minus device-busy
+  (union of op intervals inside the stepped window), the share no
+  per-op table can show;
 - a top-N HLOs-by-total-time table with per-op achieved HBM
   bandwidth (`bytes_accessed / duration`), which separates
   memory-bound fusions from compute-bound ones at a glance;
@@ -24,9 +27,26 @@ captures must first be exported to a trace (TensorBoard's profile
 plugin or `tensorflow.python.profiler` does this); the committed
 captures are already trace.json.gz.
 
+**HLO-module captures** (`*.hlo.txt[.gz]`, written by
+tools/profile_longctx.py or bench.write_decode_hlo): when no device
+profiler is reachable (this container has no TPU and the CPU profiler
+emits no per-op plane), the same classifier attributes the REAL
+compiled program's **bytes** statically — every top-level instruction
+is charged its operand + output bytes (fusion internals excluded:
+only fusion boundaries cross HBM), bucketed by the same categories.
+That is how the committed longctx captures prove the flash byte
+removal per-instruction: the dense program's attention category
+carries the O(T^2) score tensors, the flash program's does not
+(PERF.md round 8). While-loop bodies are counted once (the longctx
+captures are loop-free by construction — the blocked flash unrolls at
+the capture shape; the decode capture's per-iteration bytes are
+multiplied by max_len in the PERF analysis, and the report carries
+`while_instructions` so the caveat is machine-visible).
+
 Usage:
     python tools/trace_attribution.py TRACE.json[.gz]
         [--out X.attrib.json] [--top 10] [--json]
+    python tools/trace_attribution.py CAPTURE.hlo.txt[.gz] [...]
 
 No jax / device runtime needed — pure stdlib, runs anywhere.
 """
@@ -37,6 +57,7 @@ import argparse
 import gzip
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 
@@ -44,8 +65,8 @@ from collections import defaultdict
 HBM_PEAK_GBPS = 819.0
 
 CATEGORIES = (
-    "conv", "gemm", "bn_elementwise", "layout", "collective",
-    "infeed", "other",
+    "conv", "gemm", "attention", "bn_elementwise", "layout",
+    "collective", "infeed", "other",
 )
 
 _COLLECTIVE_TOKENS = (
@@ -57,11 +78,22 @@ _LAYOUT_NAME_PREFIXES = (
     "slice-start", "slice-done", "dynamic_slice", "dynamic-update",
     "pad",
 )
+# attention bucketing (ISSUE 12): ops under the attention
+# named_scopes (parallel/ring.py stamps dense_attention /
+# flash_attention / ring/ulysses scopes into HLO metadata op_name,
+# which trace events carry in long_name/tf_op) and Pallas/Mosaic
+# custom-call attention kernels
+_ATTENTION_TOKENS = (
+    "dense_attention", "flash_attention", "ring_attention",
+    "ulysses_attention", "flash_att",
+)
+_ATTENTION_CUSTOM_CALL_TOKENS = ("mosaic", "tpu_custom_call")
 
 
 def classify(name: str, category: str, long_name: str) -> str:
     """Map one device op to a report category. `category` is XLA's own
-    `hlo_category` arg; `long_name` the HLO text (both may be '')."""
+    `hlo_category` arg (or the HLO opcode in hlo-module captures);
+    `long_name` the HLO text incl. metadata (both may be '')."""
     n = name.lower()
     c = (category or "").lower()
     ln = (long_name or "").lower()
@@ -69,6 +101,16 @@ def classify(name: str, category: str, long_name: str) -> str:
         return "collective"
     if "infeed" in n or "outfeed" in n or "infeed" in c or "outfeed" in c:
         return "infeed"
+    # attention BEFORE conv/gemm: the attention scopes' dots/fusions
+    # must land here, and a Pallas flash kernel is a custom-call whose
+    # only category hint is its target/metadata
+    if any(t in n or t in ln for t in _ATTENTION_TOKENS):
+        return "attention"
+    if ("custom-call" in c or "custom_call" in c
+            or n.startswith("custom")) and any(
+        t in n or t in ln for t in _ATTENTION_CUSTOM_CALL_TOKENS
+    ):
+        return "attention"
     if "convolution" in c or "convolution(" in ln or n.startswith("conv_"):
         return "conv"
     if ("dot(" in ln or "dot " in ln or "gemm" in n or "gemm" in c
@@ -244,6 +286,7 @@ def analyze(path: str, top: int = 10) -> dict:
 
     report = {
         "source": os.path.basename(path),
+        "capture_kind": "profiler_trace",
         "devices": len(device_pids),
         "steps": n_steps,
         "step_ms": round(step_ms, 3) if step_ms else None,
@@ -268,6 +311,218 @@ def analyze(path: str, top: int = 10) -> dict:
         with open(sibling) as f:
             report["capture_report"] = json.load(f)
     return report
+
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+    "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8,
+    "u64": 8, "f64": 8, "c64": 8, "c128": 16, "f8e4m3fn": 1,
+    "f8e5m2": 1,
+}
+_SHAPE_RE = re.compile(
+    r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]"
+)
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"      # instruction name
+    r"((?:\([^=]*?\))|\S+)\s+"                   # output shape (or tuple)
+    r"([\w\-]+)\("                               # opcode
+)
+# instructions that move no HBM bytes of their own: reads are charged
+# at the consuming op, parameters/constants at their users, tuple
+# plumbing is free
+_FREE_OPCODES = {
+    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
+    "after-all", "partition-id", "replica-id", "iota",
+}
+
+
+def _shape_bytes(text: str) -> int:
+    """Total bytes of every dtype[shape] occurrence in `text` (tuples
+    sum their elements; scalars count their dtype size)."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(text):
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def _operand_section(rest: str) -> str:
+    """`rest` starts right after the opcode's '(' — return the operand
+    text up to its matching ')' (attributes/metadata excluded)."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i]
+    return rest
+
+
+# categories with a positive token/opcode signal; the fallback buckets
+# (bn_elementwise / layout / other) are WEAK — a weak op whose operand
+# was produced by an attention op inherits "attention" (dataflow
+# closure). XLA's backward-pass fission drops metadata from some
+# fusions (e.g. the [T,T] softmax-backward convert fusions in the
+# dense longctx capture carry no op_name at all), and without the
+# closure those score-matrix bytes silently leak into bn_elementwise.
+_STRONG_CATEGORIES = ("collective", "infeed", "attention", "conv",
+                      "gemm")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+
+
+def analyze_hlo(path: str, top: int = 10) -> dict:
+    """Static byte attribution of one compiled HLO module (the
+    `*.hlo.txt[.gz]` captures): each top-level instruction is charged
+    its output + operand bytes — at fusion granularity, exactly the
+    tensors that cross HBM — and bucketed with the same classify() as
+    the trace path (plus the weak-op dataflow inheritance above).
+    Instructions inside %fused_computation bodies are skipped (they
+    live in registers/scratch); other non-entry computations (while
+    bodies, reduce appliers) count once, with the while-instruction
+    count reported so the caveat is visible."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        lines = f.read().splitlines()
+
+    cat_bytes = defaultdict(int)
+    cat_ops = defaultdict(int)
+    by_name = {}
+    prod_cat: dict = {}  # instruction -> category (dataflow closure)
+    total = 0
+    n_instr = 0
+    n_while = 0
+    largest_output = 0
+    inherited = 0
+    in_fused = False
+    depth_at_fused = 0
+    brace_depth = 0
+    for line in lines:
+        stripped = line.strip()
+        opens = line.count("{") - line.count("}")
+        if not in_fused and (
+            stripped.startswith("%fused_computation")
+            or stripped.startswith("fused_computation")
+        ) and "{" in line:
+            in_fused = True
+            depth_at_fused = brace_depth
+        brace_depth += opens
+        if in_fused:
+            if brace_depth <= depth_at_fused:
+                in_fused = False
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, out_shape, opcode = m.groups()
+        if opcode in _FREE_OPCODES:
+            continue
+        n_instr += 1
+        if opcode == "while":
+            n_while += 1
+        rest = line[m.end():]
+        operands = _operand_section(rest)
+        out_bytes = _shape_bytes(out_shape)
+        largest_output = max(largest_output, out_bytes)
+        nbytes = out_bytes + _shape_bytes(operands)
+        cat = classify(name, opcode, line)
+        if cat not in _STRONG_CATEGORIES:
+            for op_name in _OPERAND_NAME_RE.findall(operands):
+                if prod_cat.get(op_name) == "attention":
+                    cat = "attention"
+                    inherited += 1
+                    break
+        prod_cat[name] = cat
+        cat_bytes[cat] += nbytes
+        cat_ops[cat] += 1
+        total += nbytes
+        rec = by_name.setdefault(
+            name, {"name": name, "category": cat, "bytes": 0,
+                   "count": 0},
+        )
+        rec["bytes"] += nbytes
+        rec["count"] += 1
+
+    if n_instr == 0:
+        raise SystemExit(f"{path}: no HLO instructions found")
+
+    categories = {}
+    for cat in CATEGORIES:
+        if cat_ops.get(cat, 0) == 0:
+            continue
+        categories[cat] = {
+            "bytes": cat_bytes[cat],
+            "share": round(cat_bytes[cat] / total, 4) if total else 0.0,
+            "n_ops": cat_ops[cat],
+        }
+    top_hlos = sorted(by_name.values(), key=lambda r: -r["bytes"])[:top]
+    for r in top_hlos:
+        r["share_of_bytes"] = round(r["bytes"] / total, 4) if total \
+            else 0.0
+
+    report = {
+        "source": os.path.basename(path),
+        "capture_kind": "hlo_module",
+        "total_bytes": total,
+        "n_instructions": n_instr,
+        # while bodies are charged ONCE; a loopy capture must fold its
+        # trip count in by hand (the decode analysis multiplies by
+        # max_len) — 0 means the byte table is exact
+        "while_instructions": n_while,
+        # the footprint pin: the biggest single tensor the program
+        # materializes (dense longctx: the [B,H,T,T] scores; flash:
+        # a [B,H,T,block_k] tile)
+        "largest_output_bytes": largest_output,
+        "attention_inherited_ops": inherited,
+        "shares": {c: v["share"] for c, v in categories.items()},
+        "categories": categories,
+        "top_hlos": top_hlos,
+    }
+    stem = path
+    for suf in (".hlo.txt.gz", ".hlo.txt"):
+        if stem.endswith(suf):
+            stem = stem[: -len(suf)]
+            break
+    sibling = stem + ".report.json"
+    if os.path.exists(sibling):
+        with open(sibling) as f:
+            report["capture_report"] = json.load(f)
+    return report
+
+
+def render_hlo_text(report: dict) -> str:
+    lines = [
+        f"== hlo byte attribution: {report['source']} ==",
+        f"instructions={report['n_instructions']} "
+        f"total={report['total_bytes'] / 1e6:.1f} MB "
+        f"(while bodies counted once: "
+        f"{report['while_instructions']} while op(s))",
+        "",
+        f"{'category':16s} {'share':>7s} {'MB':>10s} {'ops':>6s}",
+    ]
+    cats = sorted(
+        report["categories"].items(), key=lambda kv: -kv[1]["bytes"]
+    )
+    for cat, v in cats:
+        lines.append(
+            f"{cat:16s} {v['share'] * 100:6.2f}% "
+            f"{v['bytes'] / 1e6:10.2f} {v['n_ops']:6d}"
+        )
+    lines += [
+        "",
+        f"top {len(report['top_hlos'])} HLOs by bytes:",
+        f"{'hlo':40s} {'category':15s} {'share':>7s} {'MB':>10s}",
+    ]
+    for r in report["top_hlos"]:
+        lines.append(
+            f"{r['name'][:40]:40s} {r['category']:15s} "
+            f"{r['share_of_bytes'] * 100:6.2f}% {r['bytes'] / 1e6:10.2f}"
+        )
+    return "\n".join(lines)
 
 
 def render_text(report: dict) -> str:
@@ -312,7 +567,11 @@ def render_text(report: dict) -> str:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace", help="trace.json or trace.json.gz")
+    ap.add_argument(
+        "trace",
+        help="trace.json[.gz] (profiler capture) or hlo.txt[.gz] "
+             "(compiled-module capture)",
+    )
     ap.add_argument("--out", default="",
                     help="write the attribution report here "
                          "(default: <trace stem>.attrib.json)")
@@ -323,17 +582,23 @@ def main(argv=None) -> int:
                     help="print the JSON report instead of the table")
     args = ap.parse_args(argv)
 
-    report = analyze(args.trace, top=args.top)
+    is_hlo = args.trace.endswith((".hlo.txt", ".hlo.txt.gz"))
+    if is_hlo:
+        report = analyze_hlo(args.trace, top=args.top)
+    else:
+        report = analyze(args.trace, top=args.top)
     if args.json:
         print(json.dumps(report, indent=2))
+    elif is_hlo:
+        print(render_hlo_text(report))
     else:
         print(render_text(report))
     if not args.no_out:
         out = args.out
         if not out:
             stem = args.trace
-            for suf in (".trace.json.gz", ".trace.json", ".json.gz",
-                        ".json"):
+            for suf in (".hlo.txt.gz", ".hlo.txt", ".trace.json.gz",
+                        ".trace.json", ".json.gz", ".json"):
                 if stem.endswith(suf):
                     stem = stem[: -len(suf)]
                     break
