@@ -1,0 +1,220 @@
+"""Recompile guard — a jit-cache-miss tracker (ISSUE 13 tentpole,
+part b).
+
+The dispatch-floor work (ROADMAP 5d) and the bucketed serving program
+cache both rest on one assumption: in steady state, the hot loop's
+jitted program NEVER retraces. A silent retrace (a Python-object key
+churning, a float passed where a traced operand should be, a cache
+falling out from under a weakref) costs seconds of compile per
+occurrence and shows up in no test — only as an unexplained latency
+cliff in production. The guard turns it into a hard failure:
+
+    guard = RecompileGuard("train_step")
+
+    @jax.jit
+    def step(params, feed):
+        guard.note(params, feed)   # runs at TRACE time only
+        ...
+
+    # ... warmup: every expected shape traced once ...
+    guard.arm(strict=True)
+    # any further trace => violation (strict: RecompileError raised
+    # from inside the trace, failing the dispatch loudly)
+
+`note()` is a plain Python call in the traced function's body, so it
+executes exactly when jax (re)traces — zero cost on the cached
+dispatch path. Each guard also counts traces while disarmed (the
+warmup compile count, visible in `obs` metrics as
+`recompile_guard.traces{label=...}`).
+
+The trainer (SGD, via the `recompile_guard` flag) and the serving
+batcher (`InferenceServer.arm_recompile_guard`) arm their guards
+after warmup; `assert_steady_state()` is the bench-harness hook that
+fails a measured row whose hot loop retraced.
+
+Pure stdlib (the traced operands are only used via getattr-probed
+shape/dtype), importable with jax blocked.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+__all__ = [
+    "RecompileError", "RecompileGuard", "all_guards", "arm_all",
+    "disarm_all", "all_violations", "assert_steady_state",
+]
+
+
+class RecompileError(RuntimeError):
+    """A jitted hot loop retraced while its guard was armed."""
+
+
+_GUARDS: "weakref.WeakSet[RecompileGuard]" = weakref.WeakSet()
+_GUARDS_LOCK = threading.Lock()
+
+
+def _signature(args, kwargs):
+    """Shape/dtype signature of the traced operands — at trace time
+    these are jax tracers, whose shape/dtype are ordinary attributes
+    (no jax import needed)."""
+
+    def leaf(x):
+        s = getattr(x, "shape", None)
+        d = getattr(x, "dtype", None)
+        if s is None and d is None:
+            return type(x).__name__
+        return (tuple(s) if s is not None else None, str(d))
+
+    def walk(x):
+        if isinstance(x, dict):
+            return tuple(
+                (k, walk(v)) for k, v in sorted(x.items())
+            )
+        if isinstance(x, (list, tuple)):
+            return tuple(walk(v) for v in x)
+        return leaf(x)
+
+    return walk(list(args) + sorted(kwargs.items()))
+
+
+class RecompileGuard:
+    """One guard per jitted program family (a TrainStep, a decode
+    cache, a merged serving forward). Thread-safe."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self._lock = threading.Lock()
+        self._armed = False
+        self._strict = False
+        self.traces = 0          # total traces ever
+        self.warmup_traces = 0   # traces while disarmed
+        self.violations: list = []
+        with _GUARDS_LOCK:
+            _GUARDS.add(self)
+
+    # -- called from INSIDE the traced function ---------------------
+    def note(self, *args, **kwargs) -> None:
+        """Record one trace. Passing the traced operands gives the
+        violation record a shape signature to name the retrace."""
+        with self._lock:
+            self.traces += 1
+            armed, strict = self._armed, self._strict
+            if not armed:
+                self.warmup_traces += 1
+        self._count_metric()
+        if not armed:
+            return
+        try:
+            sig = _signature(args, kwargs)
+        except Exception:
+            sig = "<unavailable>"
+        rec = {
+            "label": self.label,
+            "ts": round(time.time(), 6),
+            "signature": repr(sig),
+            "trace_n": self.traces,
+        }
+        with self._lock:
+            self.violations.append(rec)
+        self._report_violation(rec)
+        if strict:
+            raise RecompileError(
+                f"{self.label}: jitted hot loop retraced in steady "
+                f"state (trace #{self.traces}, signature {sig!r}) — "
+                f"a cached program was expected; something in the "
+                f"call is churning the jit cache"
+            )
+
+    # -- lifecycle --------------------------------------------------
+    def arm(self, strict: bool = False) -> "RecompileGuard":
+        with self._lock:
+            self._armed = True
+            self._strict = strict
+        return self
+
+    def disarm(self) -> "RecompileGuard":
+        with self._lock:
+            self._armed = False
+        return self
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def reset(self) -> None:
+        with self._lock:
+            self.violations = []
+
+    # -- reporting (lazy obs imports: analysis stays stdlib-clean
+    # and usable before the metrics registry exists) ----------------
+    def _count_metric(self) -> None:
+        try:
+            from paddle_tpu.obs import metrics as _m
+
+            _m.get_registry().counter("recompile_guard.traces").inc(
+                label=self.label
+            )
+        except Exception:
+            pass
+
+    def _report_violation(self, rec: dict) -> None:
+        try:
+            from paddle_tpu.obs import metrics as _m
+
+            reg = _m.get_registry()
+            reg.counter("recompile_guard.violations").inc(
+                label=self.label
+            )
+            reg.event("recompile", **rec)
+        except Exception:
+            pass
+        try:
+            from paddle_tpu.obs import flight_recorder as _f
+
+            _f.maybe_dump("recompile", **rec)
+        except Exception:
+            pass
+
+
+def all_guards() -> list:
+    with _GUARDS_LOCK:
+        return sorted(_GUARDS, key=lambda g: g.label)
+
+
+def arm_all(strict: bool = False, label_prefix: str = "") -> list:
+    armed = []
+    for g in all_guards():
+        if g.label.startswith(label_prefix):
+            armed.append(g.arm(strict=strict))
+    return armed
+
+
+def disarm_all(label_prefix: str = "") -> None:
+    for g in all_guards():
+        if g.label.startswith(label_prefix):
+            g.disarm()
+
+
+def all_violations() -> list:
+    out = []
+    for g in all_guards():
+        out.extend(g.violations)
+    return out
+
+
+def assert_steady_state(label_prefix: str = "") -> None:
+    """Raise RecompileError if any (matching) guard recorded a
+    violation — the bench-harness/CI hook."""
+    bad = [
+        v for v in all_violations()
+        if v["label"].startswith(label_prefix)
+    ]
+    if bad:
+        labels = sorted({v["label"] for v in bad})
+        raise RecompileError(
+            f"{len(bad)} steady-state retrace(s) recorded on "
+            f"guard(s) {labels}: {bad[:3]}"
+        )

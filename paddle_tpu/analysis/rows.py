@@ -1,0 +1,70 @@
+"""REQUIRED_ROWS — the single source of truth for the bench-record
+row lists every lint pass enforces (ISSUE 13 satellite).
+
+Before this module, `tools/check_bench_record.py`'s static AST pass
+and its compare pass each hard-coded their own copy of the
+north-star/permanent row lists, and the two had already started to
+drift (the compare pass matched `mc_preempt_recovery`/`mc_longctx_`
+by prefix while the static pass pinned exact names). Every consumer —
+check_bench_record's static and compare modes AND the
+tools/framework_lint.py driver — now reads THIS module; bench.py's
+own `NORTH_STARS` literal stays independent on purpose (the static
+pass cross-checks it against TIMELINE_ROWS here, which is exactly the
+drift tripwire).
+
+Pure stdlib, importable with jax blocked (the lint discipline).
+"""
+
+from __future__ import annotations
+
+# permanent rows the multichip sweep must keep registering (ROADMAP 4 /
+# ISSUE 9: elasticity is measured, not assumed; ISSUE 12: the T>=32k
+# ring/Ulysses long-context rows are the measured proof the framework
+# left the reference's 2017 sequence lengths — deleting one is a
+# capability regression, not a cleanup)
+REQUIRED_MC_ROWS = (
+    "mc_checkpoint_overhead", "mc_preempt_recovery",
+    "mc_longctx_ring_t32768", "mc_longctx_ulysses_t32768",
+    "mc_longctx_ring_t131072",
+)
+
+# rows whose measured record must carry an interleaved A/B verdict
+# (ISSUE 12): `fused_speedup` (the dense-vs-flash ratio on the
+# longctx/NMT-T128 rows) or an explicit `ab_skipped` reason — the A/B
+# cannot silently drop from the record
+AB_ROWS = (
+    "longctx_selfattn_train_tokens_per_s_t4096",
+    "longctx_selfattn_train_tokens_per_s_t8192",
+    "nmt_attention_train_tokens_per_s_t128",
+)
+
+# north-star rows that must carry the timeline triple (ISSUE 10).
+# MUST equal bench.py's NORTH_STARS — check_bench_record's static
+# mode enforces the sync.
+TIMELINE_ROWS = (
+    "resnet50_train_imgs_per_s",
+    "nmt_attention_train_tokens_per_s",
+    "nmt_attention_train_tokens_per_s_bs512",
+    "nmt_attention_train_tokens_per_s_t128",
+    "nmt_beam4_decode_tokens_per_s",
+    "serve_loadtest",
+    "ctr_sparse_step_v_independence",
+    "ctr_widedeep_sparse_v_independence",
+)
+
+# row-name prefixes that ALSO must carry the timeline triple when they
+# appear in a measured record (the parameterized mc_* rows emit
+# per-mesh-shape suffixes like `mc_longctx_ring_t32768_sp4`)
+TIMELINE_ROW_PREFIXES = ("mc_preempt_recovery", "mc_longctx_")
+
+TIMELINE_FIELDS = (
+    "data_wait_frac", "host_overhead_frac", "device_frac",
+)
+
+
+def needs_timeline(metric: str) -> bool:
+    """One predicate for both lint passes: must this measured row
+    carry the per-step time-attribution triple?"""
+    return metric in TIMELINE_ROWS or metric.startswith(
+        TIMELINE_ROW_PREFIXES
+    )

@@ -224,6 +224,15 @@ class BeamSearchDecoder:
         cache = getattr(self, "_decode_cache", None)
         if cache is None:
             cache = self._decode_cache = {}
+        guard = getattr(self, "_recompile_guard", None)
+        if guard is None:
+            from paddle_tpu.analysis.recompile_guard import (
+                RecompileGuard,
+            )
+
+            guard = self._recompile_guard = RecompileGuard(
+                "beam_decode"
+            )
         if hk not in cache and len(cache) >= 8:
             # bound the cache: fresh hook lambdas per call would
             # otherwise grow it without limit (hooks should be stable
@@ -237,6 +246,11 @@ class BeamSearchDecoder:
             # one trace cache and the second hook config would silently
             # reuse the first config's compiled program.
             def core(params, static_feed, init_carry_mem, b):
+                # trace-time only (ISSUE 13): the serving batcher
+                # arms this after warmup — a steady-state retrace of
+                # a cached decode program is the 122 ms/step cliff
+                # this cache exists to prevent
+                guard.note(static_feed, init_carry_mem, b=b)
                 return self._decode_core(
                     params, static_feed, init_carry_mem, b
                 )

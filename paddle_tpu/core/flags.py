@@ -46,6 +46,14 @@ _DEFAULTS: dict[str, Any] = {
     # models stop paying the ~2-10 ms per-program dispatch floor per
     # batch; events/evaluators/watchdog still see every batch.
     "steps_per_dispatch": 1,
+    # recompile guard (analysis/recompile_guard.py, ISSUE 13): after
+    # the first pass (warmup — every expected shape incl. the ragged
+    # reader tail has traced once) the trainer arms the TrainStep's
+    # jit-cache-miss tracker. "off" = never arm; "record" = count
+    # steady-state retraces (recompile_guard.violations metric +
+    # SGD.recompile_violations()) without failing; "strict" = raise
+    # RecompileError from inside the retrace — the bench/CI mode.
+    "recompile_guard": "off",
     # per-step timeline attribution (obs/timeline.py): fence the
     # device with block_until_ready every N steps so device_step is
     # measured end-to-end while steady-state dispatch stays async.

@@ -89,10 +89,29 @@ def get_mesh() -> Mesh:
     return _current_mesh
 
 
+def _enable_cpu_collectives() -> None:
+    """jax>=0.4.30 CPU backends refuse cross-process computations
+    ("Multiprocess computations aren't implemented on the CPU
+    backend") unless a collectives implementation is configured BEFORE
+    the backend is created. When this jaxlib ships the gloo TCP
+    collectives, turn them on so the multi-process CPU smoke path
+    (launch/test_distributed_multiprocess) runs like it did on older
+    runtimes. No-op on TPU/GPU platforms and on jaxlibs without gloo."""
+    try:
+        from jax._src.lib import xla_client as _xc
+
+        if not hasattr(_xc._xla, "make_gloo_tcp_collectives"):
+            return
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - best-effort compat shim
+        pass
+
+
 def distributed_init(coordinator_address=None, num_processes=None, process_id=None):
     """Multi-host control-plane bootstrap (replaces etcd registration of
     go/pserver/etcd_client.go and the sockets of pserver/LightNetwork.h)."""
     if coordinator_address is not None:
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -43,6 +43,7 @@ import threading
 import time
 from typing import Optional
 
+from paddle_tpu.analysis.lock_order import named_lock
 from paddle_tpu.core import flags as _flags
 from paddle_tpu.obs import metrics as _metrics
 
@@ -79,7 +80,9 @@ class FlightRecorder:
         )
         self._reg = registry or _metrics.get_registry()
         self._ring = collections.deque(maxlen=self.capacity)
-        self._lock = threading.Lock()
+        # a known lock (ISSUE 13): instrumented under the faults
+        # shard's lock-order checker (analysis/lock_order.py)
+        self._lock = named_lock("obs.flight_ring")
         self._last_dump_mono: Optional[float] = None
         self._seq = 0
         self.last_bundle: Optional[dict] = None

@@ -37,6 +37,8 @@ import threading
 import time
 from typing import Optional
 
+from paddle_tpu.analysis.lock_order import named_lock
+
 # seconds-oriented default buckets: covers a 0.1 ms dispatch floor up
 # to a 60 s checkpoint stall
 DEFAULT_BUCKETS = (
@@ -154,7 +156,7 @@ class Histogram:
         self._lock = threading.Lock()
         self._series: dict = {}
 
-    def _at(self, key: tuple) -> _HistSeries:
+    def _at_locked(self, key: tuple) -> _HistSeries:
         s = self._series.get(key)
         if s is None:
             s = self._series[key] = _HistSeries(len(self.bounds))
@@ -163,7 +165,7 @@ class Histogram:
     def observe(self, value: float, **labels) -> None:
         key = _label_key(labels)
         with self._lock:
-            s = self._at(key)
+            s = self._at_locked(key)
             s.count += 1
             s.sum += value
             if value < s.min:
@@ -249,7 +251,9 @@ class EventStream:
         self.flush_interval_s = flush_interval_s
         self.rotate_bytes = rotate_bytes
         self._buf: list = []
-        self._lock = threading.Lock()
+        # a known lock (ISSUE 13): instrumented under the faults
+        # shard's lock-order checker (analysis/lock_order.py)
+        self._lock = named_lock("obs.event_stream")
         self._closed = False
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
@@ -306,7 +310,9 @@ class MetricsRegistry:
     (`get_registry()`); tests may instantiate private ones."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # a known lock (ISSUE 13): instrumented under the faults
+        # shard's lock-order checker (analysis/lock_order.py)
+        self._lock = named_lock("obs.registry")
         self._metrics: dict = {}
         self._stream: Optional[EventStream] = None
         self._recorder = None  # obs.flight_recorder.FlightRecorder

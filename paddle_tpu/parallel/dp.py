@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.analysis.recompile_guard import RecompileGuard
 from paddle_tpu.core.mesh import DATA_AXIS
 
 
@@ -96,9 +97,17 @@ class TrainStep:
         keep = set(keep_outputs or []) | set(net.output_names) | set(
             net.cost_names
         )
+        # jit-cache-miss tracker (ISSUE 13): note() runs at TRACE
+        # time only (it is a plain Python call in the traced body),
+        # so the cached dispatch path pays nothing. The trainer arms
+        # it after warmup; an armed retrace is a steady-state
+        # recompile — the silent seconds-long stall the dispatch
+        # -floor work exists to kill.
+        guard = self.recompile_guard = RecompileGuard("train_step")
 
         def step(params, opt_state, state, feed, step_i, rng,
                  lr_scale=None):
+            guard.note(params, feed)
             (loss, (outs, new_state)), grads = jax.value_and_grad(
                 net.loss_fn, has_aux=True
             )(params, feed, state=state, train=True, rng=rng)
@@ -166,6 +175,8 @@ class TrainStep:
         # [n, 2] health vectors in watchdog mode) and stacked outs.
         def multi_step(params, opt_state, state, feeds, step_i,
                        step_key, lr_scale=None):
+            guard.note(params, feeds)
+
             def body(carry, feed):
                 params, opt_state, state, i = carry
                 rng = jax.random.fold_in(step_key, i)

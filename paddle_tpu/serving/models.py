@@ -47,6 +47,15 @@ class GenerationModel:
         self.encode = encode
         self.named_hooks = named_hooks or {}
 
+    @property
+    def recompile_guards(self):
+        """The decoder's jit-cache-miss tracker (ISSUE 13), surfaced
+        so InferenceServer.arm_recompile_guard can arm every served
+        model's guards after warmup. Lazy: the guard exists once the
+        first jitted decode program was built."""
+        g = getattr(self.decoder, "_recompile_guard", None)
+        return (g,) if g is not None else ()
+
     def run_batch(self, ids, lens, hooks, host: bool):
         from paddle_tpu.serving.host_decode import host_generate
 
@@ -94,6 +103,10 @@ class _ForwardSub:
         self.name = name
         self.named_hooks = {}
 
+    @property
+    def recompile_guards(self):
+        return self.engine.recompile_guards
+
     def run_batch(self, ids, lens, hooks, host: bool):
         out = self.engine.run_group({self.name: (ids, lens)})
         return out[self.name]
@@ -125,6 +138,13 @@ class MultiForwardHost:
             else self.net.init_params(jax.random.key(seed))
         )
         self._fwd_cache = {}
+        from paddle_tpu.analysis.recompile_guard import RecompileGuard
+
+        self._recompile_guard = RecompileGuard("serve_forward")
+
+    @property
+    def recompile_guards(self):
+        return (self._recompile_guard,)
 
     def sub(self, name: str) -> _ForwardSub:
         assert name in self.confs, name
@@ -139,7 +159,15 @@ class MultiForwardHost:
         if fn is None:
             import jax
 
+            guard = self._recompile_guard
+
             def run(params, feed):
+                # trace-time only (ISSUE 13): armed after warmup by
+                # InferenceServer.arm_recompile_guard — a retrace in
+                # steady state means a bucket the warmup never saw
+                # (or a churned program cache) is paying a compile
+                # inside the serving path
+                guard.note(feed)
                 outs, _ = self.net.forward(params, feed,
                                            outputs=list(want),
                                            train=False)

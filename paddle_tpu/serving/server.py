@@ -46,6 +46,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
+from paddle_tpu.analysis.lock_order import named_lock
+from paddle_tpu.analysis.recompile_guard import RecompileError
 from paddle_tpu.core import flags as _flags
 from paddle_tpu.data.feeder import _bucket
 from paddle_tpu.obs import metrics as _obs
@@ -303,7 +305,11 @@ class InferenceServer:
         self.config = config or ServeConfig()
         self._models: dict = {}
         self._queue: deque = deque()
-        self._lock = threading.Lock()
+        # the admission-queue lock — a known lock (ISSUE 13):
+        # instrumented under the faults shard's lock-order checker
+        # (analysis/lock_order.py); the instrumented wrapper is
+        # Condition-compatible
+        self._lock = named_lock("serving.admission")
         self._work = threading.Condition(self._lock)
         self._draining = False
         self._stopped = False
@@ -424,6 +430,37 @@ class InferenceServer:
         reg.gauge("serving.queue_depth_hwm").set_max(depth)
         self._anomaly.admission(shed=False)
         return req
+
+    def arm_recompile_guard(self, strict: bool = False) -> list:
+        """Arm every registered model's jit-cache-miss trackers
+        (ISSUE 13): call after warmup traffic has touched every
+        len/batch bucket the fleet serves. From then on a retrace —
+        a bucket the warmup never saw, or a churned program cache —
+        is recorded (`recompile_guard.violations` metric, flight-
+        recorder trigger) and, with `strict`, raises RecompileError
+        out of the dispatch so the failure is loud. Returns the
+        guards armed; models registered later arm on the next call."""
+        return [
+            g.arm(strict=strict) for g in self._iter_recompile_guards()
+        ]
+
+    def disarm_recompile_guard(self) -> None:
+        for g in self._iter_recompile_guards():
+            g.disarm()
+
+    def recompile_violations(self) -> list:
+        out = []
+        for g in self._iter_recompile_guards():
+            out.extend(g.violations)
+        return out
+
+    def _iter_recompile_guards(self):
+        with self._lock:
+            entries = list(self._models.values())
+        for entry in entries:
+            for g in getattr(entry.model, "recompile_guards", ()):
+                if g is not None:
+                    yield g
 
     def stats(self) -> dict:
         with self._lock:
@@ -716,7 +753,15 @@ class InferenceServer:
                     with run_ctx:
                         rows = entry.model.run_batch(ids, lens, hooks,
                                                      host)
-                except Exception:
+                except Exception as dispatch_exc:
+                    if isinstance(dispatch_exc, RecompileError):
+                        # a STRICT armed recompile guard must stay
+                        # loud (ISSUE 13): the aborted trace cached
+                        # nothing, so a host rescue here would
+                        # silently repeat raise->fallback on every
+                        # request for this bucket while feeding false
+                        # breaker records
+                        raise
                     if host or not self.config.host_fallback or not \
                             getattr(entry.model, "can_host", False):
                         raise
@@ -801,6 +846,9 @@ class InferenceServer:
                 )
                 lat = r.t_done - r.t_submit
                 tp = r.t_popped if r.t_popped is not None else t0
+                # lint: unlocked-ok — deque.append is atomic under
+                # the GIL and exemplars tolerate interleaving; the
+                # admission lock must not cover span bookkeeping
                 self._slow.append({
                     "id": r.id,
                     "model": r.model,

@@ -49,6 +49,7 @@ import weakref
 import jax
 import numpy as np
 
+from paddle_tpu.analysis.lock_order import named_lock
 from paddle_tpu.trainer.checkpoint import _unflatten, _walk_arrays
 
 MANIFEST = "manifest.json"
@@ -149,13 +150,24 @@ def snapshot_shards(tree) -> dict:
                 tuple(p) for p in _index_sig(sh.index, arr.shape)
             )
             distinct.setdefault(sig, sh)
+        # np.asarray(shard.data) is ZERO-COPY on the CPU backend
+        # (OWNDATA=False: a view over the device buffer). The payload
+        # outlives this call — the background writer serializes it
+        # while the training loop is already DONATING these very
+        # buffers to the next step — so the snapshot must own its
+        # bytes or the written checkpoint can be torn (ISSUE 13:
+        # post-rollback restores nondeterministically produced
+        # wrong-finite params; the copy is the blocking "host
+        # snapshot" cost the async design already budgets for).
         if len(distinct) == 1:
             sh = next(iter(distinct.values()))
-            payload[f"{name}##{rtag}"] = np.asarray(sh.data)
+            payload[f"{name}##{rtag}"] = np.array(sh.data, copy=True)
         else:
             entries = {}
             for sig, sh in distinct.items():
-                payload[f"{name}##{sh.device.id}"] = np.asarray(sh.data)
+                payload[f"{name}##{sh.device.id}"] = np.array(
+                    sh.data, copy=True
+                )
                 entries[str(sh.device.id)] = [list(p) for p in sig]
             idxmeta[name] = {
                 "global_shape": list(arr.shape),
@@ -441,8 +453,10 @@ class AsyncCheckpointer:
         self.save_dir = save_dir
         self.keep_last = keep_last
         self._q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
-        self._snap_lock = threading.Lock()
-        self._err_lock = threading.Lock()
+        # known locks (ISSUE 13): instrumented under the faults
+        # shard's lock-order checker (analysis/lock_order.py)
+        self._snap_lock = named_lock("ckpt.snapshot")
+        self._err_lock = named_lock("ckpt.error")
         self._last_error: Exception | None = None
         self._verified: set = set()  # pass ids already proven complete
         self._thread = threading.Thread(

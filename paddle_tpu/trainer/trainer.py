@@ -195,6 +195,13 @@ class SGD:
     # ---- eval-only forward (jitted separately, no grad) ----
     def _eval_forward(self, feed):
         if not hasattr(self, "_fwd"):
+            from paddle_tpu.analysis.recompile_guard import (
+                RecompileGuard,
+            )
+
+            eval_guard = self._eval_guard = RecompileGuard(
+                "eval_forward"
+            )
             keep = (
                 set(self.net.output_names)
                 | set(self.net.cost_names)
@@ -207,6 +214,7 @@ class SGD:
             )
 
             def fwd(params, state, feed):
+                eval_guard.note(params, feed)
                 outs, _ = self.net.forward(
                     params, feed, state=state, train=False
                 )
@@ -555,6 +563,18 @@ class SGD:
                 log.info("pass %d timeline %s", pass_id,
                          tl.fractions())
                 event_handler(EndPass(pass_id, results))
+                if pass_id == start_pass:
+                    # warmup over: every steady-state shape (incl.
+                    # the ragged reader tail) has traced once — arm
+                    # the jit-cache-miss tracker (ISSUE 13; the
+                    # `recompile_guard` flag: off/record/strict). A
+                    # retrace from here on is a silent compile stall
+                    # in the hot loop.
+                    rg_mode = _flags.get_flag("recompile_guard")
+                    if rg_mode and rg_mode != "off":
+                        self.step_fn.recompile_guard.arm(
+                            strict=(rg_mode == "strict")
+                        )
             ok = True
         finally:
             # drain in-flight async writes on EVERY exit path so a
@@ -762,6 +782,12 @@ class SGD:
             "preempted: flushed pass %d at batch %d to %s; exiting "
             "for resume", pass_id, batches_done, save_dir,
         )
+
+    def recompile_violations(self) -> list:
+        """Steady-state retraces recorded by the train step's armed
+        recompile guard (the `recompile_guard` flag; ISSUE 13) —
+        empty means the hot loop never recompiled after warmup."""
+        return list(self.step_fn.recompile_guard.violations)
 
     def test(self, reader: Callable, feeder: Callable) -> dict:
         """Evaluation pass (reference: trainer/Tester.h)."""

@@ -14,6 +14,15 @@ N="${1:-3}"
 FAULTS_TIMEOUT="${FAULTS_TIMEOUT:-900}"
 mapfile -t FILES < <(ls tests/test_*.py)
 
+# static-analysis gate, tier 1 (ISSUE 13): the fast jax-free passes
+# (AST lint + bench-record static + obs import fence) run BEFORE the
+# shards — a tree that fails them is broken no matter what the tests
+# say, and they cost ~a second.
+if ! python tools/framework_lint.py --fast; then
+  echo "[framework_lint] fast passes FAILED — not running the suite"
+  exit 1
+fi
+
 pids=()
 for ((i = 0; i < N; i++)); do
   shard=()
@@ -39,10 +48,23 @@ done
 # AFTER the regular shards drain: the tier's SIGTERM windows and
 # loss-curve comparisons are timing-sensitive, and racing them
 # against N parallel pytest processes makes them flaky.
+# PADDLE_LOCK_CHECK=1 (ISSUE 13): the known locks are created
+# instrumented and conftest's sessionfinish hook fails the shard on
+# any lock-order inversion observed during the fault tier.
 JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  PADDLE_LOCK_CHECK=1 \
   timeout -k 15 "$FAULTS_TIMEOUT" \
   python -m pytest tests/ -q -m faults \
   >"/tmp/suite_shard_faults.log" 2>&1 || rc=1
 tail -2 /tmp/suite_shard_faults.log | sed "s/^/[shard faults] /"
+
+# static-analysis gate, tier 2 (ISSUE 13): the HLO program audit runs
+# AFTER the shards/bench smokes — donation/aliasing, host-transfer
+# and byte budgets, forbidden-op patterns over the committed captures
+# plus committed *.audit.json freshness.
+if ! python tools/framework_lint.py hlo-audit; then
+  echo "[framework_lint] hlo-audit FAILED"
+  rc=1
+fi
 exit $rc

@@ -299,8 +299,14 @@ REPO_ROOT = __import__("os").path.dirname(
 def test_make_diagram_cli():
     """`paddle make_diagram` (scripts/submit_local.sh.in:3-13) emits
     graphviz dot for an UNMODIFIED reference v1 config."""
+    import pathlib
     import subprocess
     import sys
+
+    if not pathlib.Path("/root/reference").exists():
+        # genuinely environmental (ISSUE 13 audit): the diagrammed
+        # config is the reference's own file
+        pytest.skip("reference tree not mounted")
 
     out = subprocess.run(
         [sys.executable, "-m", "paddle_tpu", "make_diagram",

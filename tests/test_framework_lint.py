@@ -1,0 +1,251 @@
+"""tools/framework_lint.py — the static-analysis driver (ISSUE 13).
+
+Pins: the driver runs green on THIS tree with jax blocked (the passes
+are pure stdlib), every AST pass actually bites on a seeded
+violation, the REQUIRED_ROWS row lists have exactly one source of
+truth consumed by check_bench_record, and run_suite.sh really wires
+the driver in (fast tier before the shards, HLO audit after, lock
+checking on the faults shard).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from paddle_tpu.analysis import ast_lint  # noqa: E402
+from paddle_tpu.analysis import rows  # noqa: E402
+
+
+def _run(args, **kw):
+    return subprocess.run(
+        [sys.executable, "tools/framework_lint.py", *args],
+        cwd=REPO, capture_output=True, text=True, timeout=300, **kw,
+    )
+
+
+class TestDriver:
+    def test_all_green_on_tree_with_jax_blocked(self):
+        """The acceptance pin: `framework_lint.py --all` passes on
+        the committed tree, in a process where importing jax dies —
+        every pass (AST, bench-static, obs, hlo-audit) is jax-free."""
+        code = (
+            "import sys\n"
+            "sys.modules['jax'] = None\n"
+            "sys.argv = ['framework_lint', '--all']\n"
+            "sys.path.insert(0, 'tools')\n"
+            "import framework_lint\n"
+            "rc = framework_lint.main(['--all'])\n"
+            "assert rc == 0, rc\n"
+            "print('LINT-OK')\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "LINT-OK" in r.stdout
+
+    def test_fast_tier_green(self):
+        r = _run(["--fast"])
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "OK" in r.stdout
+
+    def test_list_and_usage(self):
+        r = _run(["--list"])
+        assert r.returncode == 0
+        for name in ("ast", "bench-static", "obs", "hlo-audit"):
+            assert name in r.stdout
+        r = _run([])
+        assert r.returncode == 2
+        r = _run(["no-such-pass"])
+        assert r.returncode == 2
+
+    def test_violation_exits_1(self, tmp_path):
+        """A seeded violation in a scratch repo fails the driver (the
+        lint bites through the CLI, not only via the library)."""
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "obs" / "bad.py").write_text(
+            "import jax\n"
+        )
+        r = _run(["ast", "--repo", str(tmp_path)])
+        assert r.returncode == 1
+        assert "jax" in r.stderr
+
+    def _scaffold(self, tmp_path):
+        """Minimal tree satisfying the fence-existence checks."""
+        for d in ast_lint.JAX_FREE_DIRS:
+            (tmp_path / d).mkdir(parents=True, exist_ok=True)
+        for f in ast_lint.JAX_FREE_FILES:
+            p = tmp_path / f
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text("x = 1\n")
+
+
+class TestAstPasses:
+    def _scaffold(self, tmp_path):
+        TestDriver._scaffold(self, tmp_path)
+
+    def test_tree_is_clean(self):
+        assert ast_lint.run_passes(REPO) == []
+
+    def test_jax_import_fence_bites(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "serving" / "bad.py").write_text(
+            "from jaxlib import xla_client\n"
+        )
+        v = ast_lint.check_jax_import_fence(str(tmp_path))
+        assert len(v) == 1 and "bad.py:1" in v[0]
+
+    def test_jax_import_fence_flags_deleted_zone(self, tmp_path):
+        self._scaffold(tmp_path)
+        import shutil
+
+        shutil.rmtree(tmp_path / "paddle_tpu" / "obs")
+        v = ast_lint.check_jax_import_fence(str(tmp_path))
+        assert any("paddle_tpu/obs" in x and "missing" in x for x in v)
+
+    def test_function_local_jax_import_ok(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "obs" / "lazy.py").write_text(
+            "def f():\n    import jax\n    return jax\n"
+        )
+        assert ast_lint.check_jax_import_fence(str(tmp_path)) == []
+
+    def test_duplicate_dict_keys_bites(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "flags2.py").write_text(
+            "_DEFAULTS = {\n"
+            "    'seed': 0,\n"
+            "    'log_period': 100,\n"
+            "    'seed': 1,\n"
+            "}\n"
+        )
+        v = ast_lint.check_duplicate_dict_keys(str(tmp_path))
+        assert len(v) == 1 and "'seed'" in v[0]
+
+    def test_unfenced_timing_bites(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "badbench.py").write_text(
+            "import time\n"
+            "def measure(jax, x):\n"
+            "    f = jax.jit(lambda v: v + 1)\n"
+            "    t0 = time.perf_counter()\n"
+            "    f(x)\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        v = ast_lint.check_unfenced_timing(str(tmp_path))
+        assert len(v) == 1 and "measure" in v[0]
+
+    def test_fenced_timing_clean(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "goodbench.py").write_text(
+            "import time\n"
+            "def measure(jax, x):\n"
+            "    f = jax.jit(lambda v: v + 1)\n"
+            "    t0 = time.perf_counter()\n"
+            "    jax.block_until_ready(f(x))\n"
+            "    return time.perf_counter() - t0\n"
+        )
+        assert ast_lint.check_unfenced_timing(str(tmp_path)) == []
+
+    def test_unlocked_mutation_bites_and_pragma(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "racy.py").write_text(
+            "import threading\n"
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._d = {}\n"
+            "    def bad(self, k, v):\n"
+            "        self._d[k] = v\n"
+            "    def good(self, k, v):\n"
+            "        with self._lock:\n"
+            "            self._d[k] = v\n"
+            "    def justified(self, k):\n"
+            "        # lint: unlocked-ok — test pragma\n"
+            "        self._d.pop(k, None)\n"
+            "    def _helper_locked(self, k, v):\n"
+            "        self._d[k] = v\n"
+        )
+        v = ast_lint.check_unlocked_mutation(str(tmp_path))
+        assert len(v) == 1, v
+        assert "R.bad()" in v[0] and "_d" in v[0]
+
+
+class TestRowsSingleSourceOfTruth:
+    def test_check_bench_record_consumes_rows(self):
+        """Satellite pin: the static AST pass and the compare pass no
+        longer hard-code their own row lists — both read
+        paddle_tpu/analysis/rows.py, object-identically."""
+        import check_bench_record as cbr
+
+        assert cbr.TIMELINE_ROWS is rows.TIMELINE_ROWS
+        assert cbr.REQUIRED_MC_ROWS is rows.REQUIRED_MC_ROWS
+        assert cbr.AB_ROWS is rows.AB_ROWS
+        assert cbr.TIMELINE_FIELDS is rows.TIMELINE_FIELDS
+        assert cbr.needs_timeline is rows.needs_timeline
+        src = open(
+            os.path.join(REPO, "tools", "check_bench_record.py")
+        ).read()
+        # no literal copy left behind to drift
+        assert "mc_checkpoint_overhead" not in src.split(
+            "from paddle_tpu.analysis.rows"
+        )[1].split("BENCH_FILES")[0]
+
+    def test_needs_timeline_prefixes(self):
+        assert rows.needs_timeline("serve_loadtest")
+        assert rows.needs_timeline("mc_longctx_ring_t32768_sp4")
+        assert rows.needs_timeline("mc_preempt_recovery_sp2")
+        assert not rows.needs_timeline("smallnet_fc_train_steps_per_s")
+
+    def test_rows_matches_bench_north_stars(self):
+        """rows.TIMELINE_ROWS still mirrors bench.py's literal
+        NORTH_STARS (the drift tripwire's other side)."""
+        import ast as ast_mod
+
+        tree = ast_mod.parse(
+            open(os.path.join(REPO, "bench.py")).read()
+        )
+        north = None
+        for node in tree.body:
+            if isinstance(node, ast_mod.Assign) and any(
+                isinstance(t, ast_mod.Name) and t.id == "NORTH_STARS"
+                for t in node.targets
+            ):
+                north = tuple(ast_mod.literal_eval(node.value))
+        assert north == rows.TIMELINE_ROWS
+
+
+class TestSuiteWiring:
+    def test_run_suite_wires_framework_lint(self):
+        """CI satellite pin: the fast tier gates the shards, the HLO
+        audit runs after them, and the faults shard instruments the
+        known locks."""
+        sh = open(
+            os.path.join(REPO, "tests", "run_suite.sh")
+        ).read()
+        assert "framework_lint.py --fast" in sh
+        assert "framework_lint.py hlo-audit" in sh
+        assert "PADDLE_LOCK_CHECK=1" in sh
+        # ordering: fast gate before the shard loop, audit after
+        assert sh.index("framework_lint.py --fast") < sh.index(
+            "for ((i = 0"
+        )
+        assert sh.index("framework_lint.py hlo-audit") > sh.index(
+            "-m faults"
+        )
+
+    def test_committed_audit_reports_exist(self):
+        budgets = json.load(open(os.path.join(
+            REPO, "tools", "traces", "audit_budgets.json"
+        )))
+        stems = [s for s in budgets if not s.startswith("_")]
+        assert len(stems) >= 4
+        for stem in stems:
+            assert os.path.exists(os.path.join(
+                REPO, "tools", "traces", stem + ".audit.json"
+            )), f"{stem}.audit.json missing"

@@ -1,0 +1,236 @@
+"""Compiled-program auditor (paddle_tpu/analysis/hlo_audit.py,
+ISSUE 13) against the COMMITTED captures plus seeded violations.
+
+The acceptance contract: each audit (donation, host transfers, byte
+budgets, forbidden patterns) is proven to FAIL on a violating input,
+not just pass on clean input — `longctx_t4096_flash` passes the
+no-[T,T] and byte-budget checks, `longctx_t4096_dense` (the same
+model, attn_impl the only delta) FAILS them under the flash policy,
+and a synthetic non-donating module fails the donation check the
+donated `longctx_t4096_flash_train` capture passes.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from paddle_tpu.analysis import hlo_audit, hlo_text
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRACES = os.path.join(REPO, "tools", "traces")
+FLASH = os.path.join(TRACES, "longctx_t4096_flash.hlo.txt.gz")
+DENSE = os.path.join(TRACES, "longctx_t4096_dense.hlo.txt.gz")
+TRAIN = os.path.join(TRACES, "longctx_t4096_flash_train.hlo.txt.gz")
+BUDGETS = os.path.join(TRACES, "audit_budgets.json")
+
+
+def _budgets():
+    with open(BUDGETS) as f:
+        return json.load(f)
+
+
+def _flash_policy():
+    return _budgets()["longctx_t4096_flash"]
+
+
+SYNTH_DONATED = """\
+HloModule synth, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias) }, entry_computation_layout={(f32[64,64]{1,0}, f32[64,64]{1,0})->(f32[64,64]{1,0}, f32[64,64]{1,0})}
+
+ENTRY %main (p0: f32[64,64], p1: f32[64,64]) -> (f32[64,64], f32[64,64]) {
+  %p0 = f32[64,64]{1,0} parameter(0)
+  %p1 = f32[64,64]{1,0} parameter(1)
+  %add.1 = f32[64,64]{1,0} add(f32[64,64]{1,0} %p0, f32[64,64]{1,0} %p1)
+  %mul.1 = f32[64,64]{1,0} multiply(f32[64,64]{1,0} %p1, f32[64,64]{1,0} %add.1)
+  ROOT %tup = (f32[64,64]{1,0}, f32[64,64]{1,0}) tuple(f32[64,64]{1,0} %add.1, f32[64,64]{1,0} %mul.1)
+}
+"""
+
+SYNTH_NO_ALIAS = SYNTH_DONATED.replace(
+    "input_output_alias={ {0}: (0, {}, may-alias), "
+    "{1}: (1, {}, may-alias) }, ",
+    "",
+)
+
+SYNTH_OUTFEED = """\
+HloModule synth_of, is_scheduled=true, entry_computation_layout={(f32[8,8]{1,0})->f32[8,8]{1,0}}
+
+ENTRY %main (p0: f32[8,8]) -> f32[8,8] {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %add.1 = f32[8,8]{1,0} add(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p0)
+  %tok = token[] after-all()
+  %of = token[] outfeed(f32[8,8]{1,0} %add.1, token[] %tok)
+  ROOT %out = f32[8,8]{1,0} copy(f32[8,8]{1,0} %add.1)
+}
+"""
+
+SYNTH_UPCAST = """\
+HloModule synth_amp, is_scheduled=true, entry_computation_layout={(bf16[2048,2048]{1,0})->f32[2048,2048]{1,0}}
+
+ENTRY %main (p0: bf16[2048,2048]) -> f32[2048,2048] {
+  %p0 = bf16[2048,2048]{1,0} parameter(0)
+  ROOT %fusion.up = f32[2048,2048]{1,0} fusion(bf16[2048,2048]{1,0} %p0), kind=kLoop
+}
+"""
+
+
+def _write(tmp_path, name, text):
+    p = str(tmp_path / name)
+    with gzip.open(p, "wt") as f:
+        f.write(text)
+    return p
+
+
+class TestCommittedCaptures:
+    def test_flash_passes_its_committed_policy(self):
+        rep = hlo_audit.audit_capture(FLASH, _flash_policy())
+        assert rep["ok"], rep["checks"]
+        names = {c["name"] for c in rep["checks"]}
+        assert "no_tt_materialization" in names
+        assert "byte_budget.total_bytes" in names
+        assert "host_transfers" in names
+
+    def test_dense_fails_the_flash_checks(self):
+        """The lint BITES: the dense arm of the same model violates
+        the no-[T,T] tripwire AND the flash byte budgets."""
+        rep = hlo_audit.audit_capture(DENSE, _flash_policy())
+        assert not rep["ok"]
+        by = {c["name"]: c for c in rep["checks"]}
+        tt = by["no_tt_materialization"]
+        assert not tt["ok"] and tt["offenders"]
+        assert "4096" in tt["offenders"][0]
+        assert not by["byte_budget.largest_output_bytes"]["ok"]
+        assert not by["byte_budget.total_bytes"]["ok"]
+        assert not by["byte_budget.category.attention"]["ok"]
+
+    def test_dense_passes_its_own_committed_policy(self):
+        rep = hlo_audit.audit_capture(
+            DENSE, _budgets()["longctx_t4096_dense"]
+        )
+        assert rep["ok"], rep["checks"]
+
+    def test_train_capture_passes_donation(self):
+        rep = hlo_audit.audit_capture(
+            TRAIN, _budgets()["longctx_t4096_flash_train"]
+        )
+        assert rep["ok"], rep["checks"]
+        don = {c["name"]: c for c in rep["checks"]}["donation"]
+        assert don["aliased_buffers"] >= 34
+
+    def test_byte_budget_regression_bites(self):
+        """Seeded byte regression: tightening the committed budget
+        below the measured baseline fails the capture — the exact
+        mechanism by which a future byte regression fails CI."""
+        policy = dict(_flash_policy())
+        policy["total_bytes_max"] = policy["total_bytes_max"] // 2
+        rep = hlo_audit.audit_capture(FLASH, policy)
+        by = {c["name"]: c for c in rep["checks"]}
+        assert not by["byte_budget.total_bytes"]["ok"]
+        assert "regressed" in by["byte_budget.total_bytes"]["detail"]
+
+    def test_committed_audit_reports_are_fresh(self):
+        """The committed *.audit.json equals what the captures audit
+        to today (the same committed-artifact discipline as the
+        attrib reports)."""
+        reports = hlo_audit.audit_dir(TRACES, BUDGETS)
+        assert reports, "no audited captures"
+        for stem, rep in reports.items():
+            with open(
+                os.path.join(TRACES, stem + ".audit.json")
+            ) as f:
+                committed = json.load(f)
+            assert committed == rep, f"{stem}.audit.json is stale"
+            assert rep["ok"], (stem, rep["checks"])
+
+
+class TestSeededViolations:
+    def test_donation_miss_fails(self, tmp_path):
+        """Acceptance pin: a program compiled to donate 2 buffers
+        whose alias map is empty FAILS the donation audit."""
+        p = _write(tmp_path, "synth.hlo.txt.gz", SYNTH_NO_ALIAS)
+        rep = hlo_audit.audit_capture(
+            p, {"require_donation": True, "min_aliased_buffers": 2},
+            report={"donated_arg_buffers": 2},
+        )
+        assert not rep["ok"]
+        don = {c["name"]: c for c in rep["checks"]}["donation"]
+        assert don["aliased_buffers"] == 0
+        assert "doubles" in don["detail"]
+
+    def test_donation_present_passes(self, tmp_path):
+        p = _write(tmp_path, "synth.hlo.txt.gz", SYNTH_DONATED)
+        rep = hlo_audit.audit_capture(
+            p, {"require_donation": True, "min_aliased_buffers": 2},
+        )
+        assert rep["ok"], rep["checks"]
+
+    def test_host_transfer_budget_bites(self, tmp_path):
+        """Acceptance pin: an outfeed in the program FAILS the
+        zero-host-transfer budget."""
+        p = _write(tmp_path, "synth_of.hlo.txt.gz", SYNTH_OUTFEED)
+        rep = hlo_audit.audit_capture(
+            p, {"host_transfer_budget": 0}
+        )
+        assert not rep["ok"]
+        ht = {c["name"]: c for c in rep["checks"]}["host_transfers"]
+        assert ht["host_transfer_ops"] == 1
+        assert "outfeed" in ht["ops"][0]
+        # a budget of 1 admits it
+        rep2 = hlo_audit.audit_capture(
+            p, {"host_transfer_budget": 1}
+        )
+        assert rep2["ok"]
+
+    def test_f32_upcast_bites(self, tmp_path):
+        p = _write(tmp_path, "synth_amp.hlo.txt.gz", SYNTH_UPCAST)
+        rep = hlo_audit.audit_capture(
+            p, {"forbid_f32_upcast": True}
+        )
+        assert not rep["ok"]
+        up = {c["name"]: c for c in rep["checks"]}["no_f32_upcast"]
+        assert up["offenders"]
+
+    def test_missing_capture_is_a_violation(self, tmp_path):
+        budgets = tmp_path / "audit_budgets.json"
+        budgets.write_text(json.dumps({"ghost": {}}))
+        reports = hlo_audit.audit_dir(str(tmp_path), str(budgets))
+        v = hlo_audit.violations(reports)
+        assert len(v) == 1 and "missing" in v[0]
+
+
+class TestAliasParser:
+    def test_parse_nested_alias_map(self):
+        text = hlo_text.load_text(TRAIN)
+        aliased = hlo_text.parse_input_output_alias(text)
+        assert len(aliased) == 34
+        assert aliased == sorted(aliased)
+
+    def test_no_alias_map(self):
+        assert hlo_text.parse_input_output_alias(
+            "HloModule x, entry_computation_layout={()->f32[]}"
+        ) == []
+
+    def test_grad_only_captures_have_no_alias(self):
+        """Context pin for the budgets file: the grad-only longctx
+        captures (no donation at capture time) really carry no alias
+        map — which is why their policies do not require donation."""
+        for p in (FLASH, DENSE):
+            assert hlo_text.parse_input_output_alias(
+                hlo_text.load_text(p)
+            ) == []
+
+
+@pytest.mark.parametrize("stem", [
+    "longctx_t4096_flash", "longctx_t4096_dense",
+])
+def test_audit_report_schema(stem):
+    rep = hlo_audit.audit_capture(
+        os.path.join(TRACES, stem + ".hlo.txt.gz"),
+        _budgets()[stem],
+    )
+    assert rep["schema"] == hlo_audit.AUDIT_SCHEMA
+    assert rep["source"] == stem + ".hlo.txt.gz"
+    assert rep["n_instructions"] > 0
+    for c in rep["checks"]:
+        assert set(c) >= {"name", "ok", "detail"}

@@ -1,0 +1,237 @@
+"""Lock-order checker (paddle_tpu/analysis/lock_order.py, ISSUE 13).
+
+Pins: a seeded inversion (A->B in one code path, B->A in another) is
+detected as a cycle; consistent nesting is clean; `named_lock` is a
+plain threading.Lock when checking is off (the production path);
+PADDLE_LOCK_CHECK=1 instruments the real singletons (registry, event
+stream, admission queue, checkpointer, flight ring) at import and the
+instrumented admission lock still drives the server's Condition; the
+faults-shard run of the REAL subsystems records no inversion.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+
+from paddle_tpu.analysis import lock_order as lo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _pair(monitor=None):
+    m = monitor or lo.LockOrderMonitor()
+    a = lo.InstrumentedLock("A", m)
+    b = lo.InstrumentedLock("B", m)
+    return m, a, b
+
+
+class TestMonitor:
+    def test_seeded_inversion_detected(self):
+        m, a, b = _pair()
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        v = m.violations()
+        assert len(v) == 1
+        assert set(v[0]["cycle"]) == {"A", "B"}
+        assert "inversion" in v[0]["detail"]
+        # each offending edge carries the stack of its first sighting
+        assert any(
+            "test_lock_order" in s for s in v[0]["stacks"].values()
+        )
+
+    def test_consistent_nesting_is_clean(self):
+        m, a, b = _pair()
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert m.violations() == []
+        assert ("A", "B") in m.edges()
+
+    def test_three_lock_cycle(self):
+        m = lo.LockOrderMonitor()
+        a, b, c = (lo.InstrumentedLock(n, m) for n in "ABC")
+        with a, b:
+            pass
+        with b, c:
+            pass
+        with c, a:
+            pass
+        v = m.violations()
+        assert len(v) == 1 and set(v[0]["cycle"]) == {"A", "B", "C"}
+
+    def test_cross_thread_edges_combine(self):
+        """The inversion only exists across threads — thread 1 takes
+        A->B, thread 2 takes B->A; the global graph still cycles."""
+        m, a, b = _pair()
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        def t2():
+            with b:
+                with a:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th1.start()
+        th1.join()
+        th2 = threading.Thread(target=t2)
+        th2.start()
+        th2.join()
+        assert len(m.violations()) == 1
+
+    def test_reacquire_same_name_no_self_edge(self):
+        m, a, _ = _pair()
+        with a:
+            pass
+        with a:
+            pass
+        assert m.violations() == []
+        assert m.edges() == {}
+
+    def test_reset(self):
+        m, a, b = _pair()
+        with a, b:
+            pass
+        with b, a:
+            pass
+        assert m.violations()
+        m.reset()
+        assert m.violations() == [] and m.edges() == {}
+
+
+class TestNamedLock:
+    def test_plain_lock_when_disabled(self):
+        assert not lo.enabled() or True  # state under pytest: off
+        if lo.enabled():
+            return  # running inside a PADDLE_LOCK_CHECK session
+        lk = lo.named_lock("x")
+        assert isinstance(lk, type(threading.Lock()))
+
+    def test_instrumented_when_enabled(self):
+        was = lo.enabled()
+        lo.enable()
+        try:
+            lk = lo.named_lock("y")
+            assert isinstance(lk, lo.InstrumentedLock)
+            assert lk.name == "y"
+        finally:
+            if not was:
+                lo.disable()
+
+    def test_condition_compat(self):
+        """threading.Condition over an InstrumentedLock: wait/notify
+        across threads works and the held-set bookkeeping survives
+        wait()'s out-of-band release/reacquire."""
+        m = lo.LockOrderMonitor()
+        lk = lo.InstrumentedLock("cond", m)
+        cond = threading.Condition(lk)
+        hits = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=10)
+                hits.append("woke")
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+
+        time.sleep(0.1)
+        with cond:
+            cond.notify()
+        t.join(timeout=10)
+        assert hits == ["woke"]
+        assert m.violations() == []
+
+
+class TestKnownLocksIntegration:
+    def test_env_var_instruments_the_singletons(self):
+        """PADDLE_LOCK_CHECK=1 at process start instruments the known
+        locks, and a realistic faults-shard slice (metrics + events +
+        flight ring + admission queue + async checkpointer, all
+        exercised together) records NO inversion — the clean-bill
+        half of the faults-shard gate."""
+        code = (
+            "import threading\n"
+            "from paddle_tpu.analysis import lock_order as lo\n"
+            "assert lo.enabled()\n"
+            "from paddle_tpu.obs import metrics as m\n"
+            "from paddle_tpu.obs import flight_recorder as fr\n"
+            "reg = m.get_registry()\n"
+            "assert isinstance(reg._lock, lo.InstrumentedLock)\n"
+            "rec = fr.FlightRecorder(registry=reg)\n"
+            "assert isinstance(rec._lock, lo.InstrumentedLock)\n"
+            "reg.attach_recorder(rec)\n"
+            "import tempfile, os\n"
+            "d = tempfile.mkdtemp()\n"
+            "m.enable_event_stream(os.path.join(d, 'ev.jsonl'))\n"
+            "for i in range(50):\n"
+            "    reg.counter('c').inc()\n"
+            "    reg.event('k', i=i)\n"
+            "rec.maybe_dump('test')\n"
+            "from paddle_tpu.serving.server import "
+            "InferenceServer, ServeConfig\n"
+            "srv = InferenceServer(ServeConfig(workers=2))\n"
+            "assert isinstance(srv._lock, lo.InstrumentedLock)\n"
+            "srv.shutdown()\n"
+            "assert lo.violations() == [], lo.violations()\n"
+            "assert ('obs.registry', 'obs.flight_ring') "
+            "not in [v['cycle'] for v in lo.violations()]\n"
+            "print('CLEAN', len(lo.edges()))\n"
+        )
+        env = dict(os.environ, PADDLE_LOCK_CHECK="1",
+                   JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "CLEAN" in r.stdout
+
+    def test_conftest_gate_fails_on_inversion(self, tmp_path):
+        """The faults-shard wiring end-to-end: a pytest session under
+        PADDLE_LOCK_CHECK=1 whose tests seed an inversion exits
+        non-zero EVEN THOUGH every test passed."""
+        test = tmp_path / "test_seeded_inversion.py"
+        test.write_text(
+            "from paddle_tpu.analysis import lock_order as lo\n"
+            "def test_invert():\n"
+            "    a = lo.named_lock('seed.A')\n"
+            "    b = lo.named_lock('seed.B')\n"
+            "    with a:\n"
+            "        with b:\n"
+            "            pass\n"
+            "    with b:\n"
+            "        with a:\n"
+            "            pass\n"
+        )
+        conftest = tmp_path / "conftest.py"
+        src = open(
+            os.path.join(REPO, "tests", "conftest.py")
+        ).read()
+        # reuse ONLY the sessionfinish hook (the real conftest also
+        # forces the 8-device mesh, irrelevant and slow here)
+        hook = src[src.index("def pytest_sessionfinish"):
+                   src.index("def start_master")]
+        conftest.write_text(hook)
+        env = dict(os.environ, PADDLE_LOCK_CHECK="1",
+                   JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO)
+        r = subprocess.run(
+            [sys.executable, "-m", "pytest", str(test), "-q",
+             "-p", "no:cacheprovider"],
+            cwd=str(tmp_path), env=env,
+            capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 3, r.stdout + r.stderr
+        assert "LOCK-ORDER VIOLATION" in r.stdout
+        assert "seed.A" in r.stdout and "seed.B" in r.stdout

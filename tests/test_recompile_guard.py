@@ -1,0 +1,227 @@
+"""Recompile guard (paddle_tpu/analysis/recompile_guard.py,
+ISSUE 13): the jit-cache-miss tracker the trainer and serving batcher
+arm after warmup.
+
+Acceptance pin: the guard FAILS on a seeded violation — a post-warmup
+shape change retraces the TrainStep and (strict) raises
+RecompileError / (record) lands in `SGD.recompile_violations()` and
+the `recompile_guard.violations` metric; the serving batcher's guard
+trips on a cold bucket after `arm_recompile_guard`.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import dsl
+from paddle_tpu.analysis import recompile_guard as rg
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.core.arg import id_arg, non_seq
+from paddle_tpu.core.config import OptimizationConf
+from paddle_tpu.trainer.trainer import SGD
+
+OPT = OptimizationConf(learning_method="adam", learning_rate=1e-2)
+
+
+def _conf():
+    with dsl.model() as m:
+        x = dsl.data("x", dim=8)
+        y = dsl.data("label", dim=(), is_ids=True)
+        o = dsl.fc(dsl.fc(x, size=16, act="relu"), size=4, act="")
+        dsl.classification_cost(o, y)
+    return m.conf
+
+
+def _batches(n, bs=8, seed=0):
+    r = np.random.default_rng(seed)
+    return [
+        (r.standard_normal((bs, 8)).astype(np.float32),
+         r.integers(0, 4, bs).astype(np.int32))
+        for _ in range(n)
+    ]
+
+
+def _feeder(raw):
+    return {"x": non_seq(raw[0]), "label": id_arg(raw[1])}
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    _flags.reset_flags()
+
+
+class TestGuardUnit:
+    def test_warmup_counts_and_arming(self):
+        g = rg.RecompileGuard("unit")
+        g.note(np.zeros((2, 2)))
+        g.note(np.zeros((4, 2)))
+        assert g.traces == 2 and g.warmup_traces == 2
+        assert not g.violations
+        g.arm(strict=False)
+        g.note(np.zeros((8, 2)))
+        assert len(g.violations) == 1
+        v = g.violations[0]
+        assert v["label"] == "unit" and "(8, 2)" in v["signature"]
+        g.disarm()
+        g.note(np.zeros((16, 2)))
+        assert len(g.violations) == 1  # disarmed: counted, not flagged
+
+    def test_strict_raises_from_note(self):
+        g = rg.RecompileGuard("unit_strict").arm(strict=True)
+        with pytest.raises(rg.RecompileError, match="retraced"):
+            g.note(np.zeros((2,)))
+
+    def test_assert_steady_state_and_label_filter(self):
+        a = rg.RecompileGuard("fleet.a").arm()
+        rg.RecompileGuard("fleet.b").arm()
+        a.note()
+        with pytest.raises(rg.RecompileError, match="fleet.a"):
+            rg.assert_steady_state("fleet.")
+        rg.assert_steady_state("fleet.b")  # b is clean
+        rg.disarm_all("fleet.")
+        assert not any(
+            g.armed for g in rg.all_guards()
+            if g.label.startswith("fleet.")
+        )
+
+    def test_violation_counts_in_registry(self):
+        from paddle_tpu.obs import metrics as _m
+
+        reg = _m.get_registry()
+        before = reg.counter("recompile_guard.violations").get(
+            label="unit_metric"
+        )
+        g = rg.RecompileGuard("unit_metric").arm()
+        g.note()
+        assert reg.counter("recompile_guard.violations").get(
+            label="unit_metric"
+        ) == before + 1
+
+
+class TestTrainerGuard:
+    def test_armed_after_first_pass_and_strict_raises(self):
+        """The flag contract: warmup = the first pass; a steady-state
+        shape change then fails LOUDLY in strict mode."""
+        _flags.set_flag("recompile_guard", "strict")
+        t = SGD(_conf(), OPT, seed=1)
+        g = t.step_fn.recompile_guard
+        assert not g.armed
+        t.train(reader=lambda: iter(_batches(3)), feeder=_feeder,
+                num_passes=2)
+        assert g.armed and g.warmup_traces >= 1
+        assert t.recompile_violations() == []
+        with pytest.raises(rg.RecompileError, match="train_step"):
+            t.train(reader=lambda: iter(_batches(2, bs=16)),
+                    feeder=_feeder, num_passes=1)
+        assert len(t.recompile_violations()) == 1
+
+    def test_record_mode_does_not_raise(self):
+        _flags.set_flag("recompile_guard", "record")
+        t = SGD(_conf(), OPT, seed=1)
+        t.train(reader=lambda: iter(_batches(3)), feeder=_feeder,
+                num_passes=2)
+        # seeded violation: a cold shape in steady state
+        t.train(reader=lambda: iter(_batches(2, bs=32)),
+                feeder=_feeder, num_passes=1)
+        vs = t.recompile_violations()
+        assert len(vs) == 1 and vs[0]["label"] == "train_step"
+
+    def test_default_off_never_arms(self):
+        t = SGD(_conf(), OPT, seed=1)
+        t.train(reader=lambda: iter(_batches(3)), feeder=_feeder,
+                num_passes=2)
+        assert not t.step_fn.recompile_guard.armed
+        # shape changes stay legal (the 2017 contract): no violations
+        t.train(reader=lambda: iter(_batches(2, bs=16)),
+                feeder=_feeder, num_passes=1)
+        assert t.recompile_violations() == []
+
+    def test_steady_state_without_shape_change_is_clean(self):
+        _flags.set_flag("recompile_guard", "strict")
+        t = SGD(_conf(), OPT, seed=1)
+        for _ in range(3):
+            t.train(reader=lambda: iter(_batches(3)),
+                    feeder=_feeder, num_passes=1)
+        assert t.recompile_violations() == []
+
+
+class TestServingGuard:
+    def _host(self):
+        from paddle_tpu.serving.models import MultiForwardHost
+
+        with dsl.model() as g:
+            w = dsl.data("w", (1,), is_seq=True, is_ids=True)
+            emb = dsl.embedding(w, size=8, vocab_size=20, name="emb")
+            pooled = dsl.seq_pool(emb, pool_type="average",
+                                  name="pool")
+            dsl.fc(pooled, size=3, act="softmax", name="out")
+            g.conf.output_layer_names.append("out")
+        return MultiForwardHost({"m": g.conf})
+
+    def test_batcher_guard_trips_on_cold_bucket(self):
+        """Warm one len-bucket, arm, then serve a request landing in
+        a DIFFERENT bucket: the merged forward retraces and the armed
+        guard records it — the silent serving compile stall, caught."""
+        import numpy as np2
+
+        host = self._host()
+        (guard,) = host.recompile_guards
+
+        def run(n):
+            ids = np2.zeros((1, n), np2.int32)
+            ids[0, :n] = np2.arange(1, n + 1)
+            host.run_group(
+                {"m": (ids, np2.asarray([n], np2.int32))}
+            )
+
+        run(4)  # warmup: the len-4 program traces + compiles
+        assert guard.warmup_traces == 1
+        guard.arm(strict=False)
+        run(4)  # cached: no trace, no violation
+        assert guard.violations == []
+        run(32)  # cold bucket in steady state
+        assert len(guard.violations) == 1
+        assert guard.violations[0]["label"] == "serve_forward"
+
+    def test_strict_guard_is_loud_through_dispatch(self):
+        """Strict mode must FAIL the request, not get silently
+        rescued by the host-fallback rung (the aborted trace caches
+        nothing, so a rescue would repeat raise->fallback on every
+        request for the bucket)."""
+        from paddle_tpu.serving.server import (
+            InferenceServer,
+            ServeConfig,
+            ServeError,
+        )
+
+        host = self._host()
+        srv = InferenceServer(ServeConfig(max_queue=8, max_batch=2))
+        try:
+            srv.add_model("m", host.sub("m"))
+            srv.submit("m", [1, 2, 3]).result(timeout=120)  # warmup
+            srv.arm_recompile_guard(strict=True)
+            req = srv.submit("m", list(range(1, 25)))  # cold bucket
+            with pytest.raises(ServeError, match="RecompileError"):
+                req.result(timeout=120)
+        finally:
+            srv.shutdown()
+
+    def test_server_arm_collects_model_guards(self):
+        from paddle_tpu.serving.server import (
+            InferenceServer,
+            ServeConfig,
+        )
+
+        host = self._host()
+        srv = InferenceServer(ServeConfig(max_queue=8, max_batch=2))
+        try:
+            srv.add_model("m", host.sub("m"))
+            srv.submit("m", [1, 2, 3]).result(timeout=120)  # warmup
+            armed = srv.arm_recompile_guard(strict=False)
+            assert host._recompile_guard in armed
+            assert host._recompile_guard.armed
+            assert srv.recompile_violations() == []
+            srv.disarm_recompile_guard()
+            assert not host._recompile_guard.armed
+        finally:
+            srv.shutdown()

@@ -10,6 +10,7 @@ discipline (two different configs computing the same function).
 """
 
 import os
+import pathlib
 import textwrap
 
 import jax
@@ -26,6 +27,15 @@ from paddle_tpu.network import Network
 from paddle_tpu.optimizers import create_optimizer
 
 REF = "/root/reference"
+
+# genuinely environmental (ISSUE 13 audit): every test here execs the
+# reference's OWN config files from /root/reference; without that
+# mount there is nothing to parse. Same canonical guard + reason
+# string as the other nine reference-battery files (this, the oldest,
+# simply never got it).
+pytestmark = pytest.mark.skipif(
+    not pathlib.Path(REF).exists(), reason="reference tree not mounted"
+)
 
 
 def _train_steps(tc, feed, steps=2):

@@ -48,6 +48,12 @@ both run by `tests/test_check_bench_record.py`:
   flight-recorder bundles (obs/flight_recorder.py) — schema tag,
   required top-level fields, well-formed span events.
 
+The enforced row lists (REQUIRED_MC_ROWS / AB_ROWS / TIMELINE_ROWS)
+live in `paddle_tpu/analysis/rows.py` — ONE source of truth consumed
+by the static pass, the compare pass, and the
+`tools/framework_lint.py` driver (ISSUE 13), which also runs the
+`static` and `obs` modes here as its `bench-static` / `obs` passes.
+
 Usage:
     python tools/check_bench_record.py static [repo_dir]
     python tools/check_bench_record.py compare STDOUT_FILE RECORD_FILE
@@ -65,44 +71,24 @@ import os
 import sys
 from collections import Counter
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# the row lists both the static AST pass and the compare pass enforce
+# come from ONE source of truth (ISSUE 13 satellite): each pass used
+# to hard-code its own copy and they had started to drift.
+# paddle_tpu/analysis/rows.py is pure stdlib — importable with jax
+# blocked, like this whole tool.
+from paddle_tpu.analysis.rows import (  # noqa: E402
+    AB_ROWS,
+    REQUIRED_MC_ROWS,
+    TIMELINE_FIELDS,
+    TIMELINE_ROWS,
+    needs_timeline,
+)
+
 BENCH_FILES = ("bench.py", "bench_multichip.py")
-
-# permanent rows the multichip sweep must keep registering (ROADMAP 4 /
-# ISSUE 9: elasticity is measured, not assumed; ISSUE 12: the T>=32k
-# ring/Ulysses long-context rows are the measured proof the framework
-# left the reference's 2017 sequence lengths — deleting one is a
-# capability regression, not a cleanup)
-REQUIRED_MC_ROWS = (
-    "mc_checkpoint_overhead", "mc_preempt_recovery",
-    "mc_longctx_ring_t32768", "mc_longctx_ulysses_t32768",
-    "mc_longctx_ring_t131072",
-)
-
-# rows whose measured record must carry an interleaved A/B verdict
-# (ISSUE 12): `fused_speedup` (the dense-vs-flash ratio on the
-# longctx/NMT-T128 rows) or an explicit `ab_skipped` reason — the A/B
-# cannot silently drop from the record
-AB_ROWS = (
-    "longctx_selfattn_train_tokens_per_s_t4096",
-    "longctx_selfattn_train_tokens_per_s_t8192",
-    "nmt_attention_train_tokens_per_s_t128",
-)
-
-# north-star rows that must carry the timeline triple (ISSUE 10).
-# MUST equal bench.py's NORTH_STARS — static mode enforces the sync.
-TIMELINE_ROWS = (
-    "resnet50_train_imgs_per_s",
-    "nmt_attention_train_tokens_per_s",
-    "nmt_attention_train_tokens_per_s_bs512",
-    "nmt_attention_train_tokens_per_s_t128",
-    "nmt_beam4_decode_tokens_per_s",
-    "serve_loadtest",
-    "ctr_sparse_step_v_independence",
-    "ctr_widedeep_sparse_v_independence",
-)
-TIMELINE_FIELDS = (
-    "data_wait_frac", "host_overhead_frac", "device_frac",
-)
 
 # serve_loadtest span-derived split (ISSUE 11): required fields and
 # the cross-check tolerance against the registry triple. The two
@@ -319,8 +305,7 @@ def check_compare(stdout_path: str, record_path: str) -> list:
     # attribution triple means an input-pipeline bubble could hide
     for d in printed_rows:
         m = d["metric"]
-        if (m in TIMELINE_ROWS or m.startswith("mc_preempt_recovery")
-                or m.startswith("mc_longctx_")) \
+        if needs_timeline(m) \
                 and "error" not in d and "skipped" not in d:
             missing = [f for f in TIMELINE_FIELDS if f not in d]
             if missing:

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""framework_lint — the single driver for every static-analysis pass
+(ISSUE 13).
+
+Registered passes (run one by name, `--fast`, or `--all`):
+
+  ast          paddle_tpu/analysis/ast_lint.py source passes over the
+               tree: jax-import fence, duplicate dict keys, unfenced
+               timing around async dispatch, unlocked container
+               mutation. Pure AST, jax-free, fast — run BEFORE the
+               test shards.
+  bench-static tools/check_bench_record.py `static` mode (bench rows
+               must flow through emit(); permanent rows registered;
+               NORTH_STARS/TIMELINE_ROWS drift tripwire), subsumed
+               here as a registered pass.
+  obs          check_bench_record `obs` mode (no module-scope jax in
+               paddle_tpu/obs/; required modules present), subsumed.
+  hlo-audit    paddle_tpu/analysis/hlo_audit.py over every capture
+               named in tools/traces/audit_budgets.json: donation/
+               aliasing, host-transfer budget, byte budgets vs the
+               committed baseline, forbidden-op patterns (no [T,T] on
+               flash captures, no AMP f32 upcasts). Also verifies the
+               committed *.audit.json reports still match the
+               captures they describe — a stale report is itself a
+               violation. `--write-audit` refreshes them after an
+               intentional perf change (then re-baseline
+               audit_budgets.json by hand: budgets never auto-widen).
+
+Runtime tripwires live next door and are driven elsewhere: the
+recompile guard (analysis/recompile_guard.py) arms inside the trainer
+/serving batcher, and the lock-order checker (analysis/lock_order.py)
+instruments the known locks when the faults shard runs with
+PADDLE_LOCK_CHECK=1 (tests/run_suite.sh).
+
+Usage:
+    python tools/framework_lint.py --all
+    python tools/framework_lint.py --fast          # jax-free AST tier
+    python tools/framework_lint.py ast obs ...     # specific passes
+    python tools/framework_lint.py hlo-audit --write-audit
+    python tools/framework_lint.py --list
+
+Exit 0 = clean, 1 = violations (printed to stderr), 2 = usage error.
+Everything here is pure stdlib — no jax, no device runtime.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+TRACES_DIR = os.path.join(_TOOLS, "traces")
+
+
+# ---- passes -------------------------------------------------------
+def pass_ast(repo: str, _args) -> list:
+    from paddle_tpu.analysis import ast_lint
+
+    return ast_lint.run_passes(repo)
+
+
+def pass_bench_static(repo: str, _args) -> list:
+    import check_bench_record as cbr
+
+    return [f"[bench-static] {v}" for v in cbr.check_static(repo)]
+
+
+def pass_obs(repo: str, _args) -> list:
+    import check_bench_record as cbr
+
+    return [f"[obs] {v}" for v in cbr.check_obs_imports(repo)]
+
+
+def pass_hlo_audit(repo: str, args) -> list:
+    from paddle_tpu.analysis import hlo_audit
+
+    traces = os.path.join(repo, "tools", "traces")
+    if not os.path.isdir(traces):
+        traces = TRACES_DIR
+    budgets = os.path.join(traces, "audit_budgets.json")
+    if not os.path.exists(budgets):
+        return [
+            f"[hlo-audit] {budgets}: missing — the byte-budget "
+            f"baselines are gone; the audit has nothing to enforce"
+        ]
+    reports = hlo_audit.audit_dir(traces, budgets)
+    violations = [
+        f"[hlo-audit] {v}" for v in hlo_audit.violations(reports)
+    ]
+    # committed report freshness: the *.audit.json next to each
+    # capture must be exactly what the capture audits to today —
+    # stale reports lie about what the lint enforces
+    for stem, rep in sorted(reports.items()):
+        out_path = os.path.join(traces, stem + ".audit.json")
+        if getattr(args, "write_audit", False):
+            with open(out_path, "w") as f:
+                json.dump(rep, f, indent=2)
+                f.write("\n")
+            print(f"framework_lint: wrote {out_path}")
+            continue
+        if not os.path.exists(out_path):
+            violations.append(
+                f"[hlo-audit] {stem}: no committed audit report "
+                f"({os.path.basename(out_path)}) — run "
+                f"`python tools/framework_lint.py hlo-audit "
+                f"--write-audit` and commit it"
+            )
+            continue
+        with open(out_path) as f:
+            committed = json.load(f)
+        if committed != rep:
+            violations.append(
+                f"[hlo-audit] {stem}: committed audit report is "
+                f"STALE (capture or auditor changed since it was "
+                f"written) — regenerate with --write-audit"
+            )
+    return violations
+
+
+PASSES = {
+    "ast": pass_ast,
+    "bench-static": pass_bench_static,
+    "obs": pass_obs,
+    "hlo-audit": pass_hlo_audit,
+}
+# the jax-free tier cheap enough to gate every suite run up front
+FAST_PASSES = ("ast", "bench-static", "obs")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="framework_lint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("passes", nargs="*",
+                    help=f"pass names ({', '.join(PASSES)})")
+    ap.add_argument("--all", action="store_true",
+                    help="run every registered pass")
+    ap.add_argument("--fast", action="store_true",
+                    help=f"run the fast jax-free tier "
+                         f"({', '.join(FAST_PASSES)})")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes")
+    ap.add_argument("--repo", default=_REPO,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--write-audit", action="store_true",
+                    help="(hlo-audit) regenerate the committed "
+                         "*.audit.json reports")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name in PASSES:
+            print(name)
+        return 0
+    names = list(args.passes)
+    if args.all:
+        names = list(PASSES)
+    elif args.fast:
+        names = list(FAST_PASSES)
+    if not names:
+        ap.print_usage(sys.stderr)
+        print(
+            "framework_lint: name at least one pass, or --all/--fast",
+            file=sys.stderr,
+        )
+        return 2
+    unknown = [n for n in names if n not in PASSES]
+    if unknown:
+        print(
+            f"framework_lint: unknown pass(es) {unknown}; "
+            f"registered: {list(PASSES)}",
+            file=sys.stderr,
+        )
+        return 2
+
+    violations = []
+    for name in names:
+        violations.extend(PASSES[name](args.repo, args))
+    for v in violations:
+        print(f"framework_lint: {v}", file=sys.stderr)
+    if not violations:
+        print(f"framework_lint: OK ({', '.join(names)})")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -61,6 +61,46 @@ def build_step(conf, feed, seed=0):
     return gf, params
 
 
+def build_update_step(conf, feed, seed=0):
+    """jitted fwd+bwd+SGD-update step with DONATED param/opt buffers —
+    the capture the donation audit (analysis/hlo_audit.py, ISSUE 13)
+    runs on: every donated buffer must appear in the compiled
+    module's input_output_alias map, else the step keeps params live
+    twice and HBM footprint silently doubles. Returns
+    (jitted_fn, params, opt_state, donated_buffer_count)."""
+    import jax
+
+    from paddle_tpu.core.config import OptimizationConf
+    from paddle_tpu.network import Network
+    from paddle_tpu.optimizers import create_optimizer
+
+    net = Network(conf)
+    params = net.init_params(jax.random.key(seed))
+    state = net.init_state()
+    opt = create_optimizer(
+        OptimizationConf(learning_method="momentum",
+                         learning_rate=0.01, momentum=0.9),
+        net.param_confs,
+    )
+    opt_state = opt.init_state(params)
+    key = jax.random.key(1)
+
+    def update(p, ost, f):
+        def loss(p, f):
+            return net.loss_fn(
+                p, f, state=state, rng=key, train=True
+            )[0]
+
+        grads = jax.grad(loss)(p, f)
+        return opt.update(grads, p, ost, 0)
+
+    uf = jax.jit(update, donate_argnums=(0, 1))
+    donated = len(jax.tree_util.tree_leaves(params)) + len(
+        jax.tree_util.tree_leaves(opt_state)
+    )
+    return uf, params, opt_state, donated
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--t", type=int, default=4096)
@@ -70,6 +110,11 @@ def main():
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--classes", type=int, default=512)
     ap.add_argument("--impls", default="dense,flash")
+    ap.add_argument("--update-step", action="store_true",
+                    help="capture the full train-update step with "
+                         "DONATED param/opt buffers (writes "
+                         "longctx_t{T}_{impl}_train.* — the donation"
+                         "-audit capture, ISSUE 13)")
     ap.add_argument("--out-dir", default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "traces"))
     ap.add_argument("--run", action="store_true",
@@ -95,6 +140,35 @@ def main():
             args.t, args.d, args.heads, args.layers, args.classes,
             attn_impl=impl,
         )
+        if args.update_step:
+            uf, params, opt_state, donated = build_update_step(
+                conf, feed
+            )
+            compiled = uf.lower(params, opt_state, feed).compile()
+            stem = os.path.join(
+                args.out_dir, f"longctx_t{args.t}_{impl}_train"
+            )
+            with gzip.open(stem + ".hlo.txt.gz", "wt") as f:
+                f.write(compiled.as_text())
+            report = {
+                "model": "bench.longctx_conf full update step "
+                         "(donated params+opt buffers)",
+                "attn_impl": impl,
+                "batch_size": args.bs,
+                "seq_len": args.t,
+                "d_model": args.d,
+                "heads": args.heads,
+                "layers": args.layers,
+                "backend": jax.default_backend(),
+                # the donation audit's contract: at least this many
+                # input buffers must appear in input_output_alias
+                "donated_arg_buffers": donated,
+            }
+            with open(stem + ".report.json", "w") as f:
+                json.dump(report, f, indent=2)
+                f.write("\n")
+            print(json.dumps({"impl": impl, **report}))
+            continue
         gf, params = build_step(conf, feed)
         compiled = gf.lower(params, feed).compile()
         ca = compiled.cost_analysis()

@@ -57,79 +57,26 @@ import argparse
 import gzip
 import json
 import os
-import re
 import sys
 from collections import defaultdict
 
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+# the HLO parser + op classifier live in paddle_tpu/analysis/hlo_text
+# (ISSUE 13): one parser shared with the static auditor
+# (analysis/hlo_audit.py, tools/framework_lint.py), so the audit
+# argues about the exact bytes this tool attributes. Names re-exported
+# here for back-compat with existing callers/tests.
+from paddle_tpu.analysis.hlo_text import (  # noqa: E402
+    CATEGORIES,
+    analyze_hlo,
+    classify,
+)
+
 # v5e reference numbers for the table's context columns
 HBM_PEAK_GBPS = 819.0
-
-CATEGORIES = (
-    "conv", "gemm", "attention", "bn_elementwise", "layout",
-    "collective", "infeed", "other",
-)
-
-_COLLECTIVE_TOKENS = (
-    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
-    "collective", "send", "recv",
-)
-_LAYOUT_NAME_PREFIXES = (
-    "copy", "transpose", "bitcast", "reshape", "convert_element_type",
-    "slice-start", "slice-done", "dynamic_slice", "dynamic-update",
-    "pad",
-)
-# attention bucketing (ISSUE 12): ops under the attention
-# named_scopes (parallel/ring.py stamps dense_attention /
-# flash_attention / ring/ulysses scopes into HLO metadata op_name,
-# which trace events carry in long_name/tf_op) and Pallas/Mosaic
-# custom-call attention kernels
-_ATTENTION_TOKENS = (
-    "dense_attention", "flash_attention", "ring_attention",
-    "ulysses_attention", "flash_att",
-)
-_ATTENTION_CUSTOM_CALL_TOKENS = ("mosaic", "tpu_custom_call")
-
-
-def classify(name: str, category: str, long_name: str) -> str:
-    """Map one device op to a report category. `category` is XLA's own
-    `hlo_category` arg (or the HLO opcode in hlo-module captures);
-    `long_name` the HLO text incl. metadata (both may be '')."""
-    n = name.lower()
-    c = (category or "").lower()
-    ln = (long_name or "").lower()
-    if any(t in n or t in c for t in _COLLECTIVE_TOKENS):
-        return "collective"
-    if "infeed" in n or "outfeed" in n or "infeed" in c or "outfeed" in c:
-        return "infeed"
-    # attention BEFORE conv/gemm: the attention scopes' dots/fusions
-    # must land here, and a Pallas flash kernel is a custom-call whose
-    # only category hint is its target/metadata
-    if any(t in n or t in ln for t in _ATTENTION_TOKENS):
-        return "attention"
-    if ("custom-call" in c or "custom_call" in c
-            or n.startswith("custom")) and any(
-        t in n or t in ln for t in _ATTENTION_CUSTOM_CALL_TOKENS
-    ):
-        return "attention"
-    if "convolution" in c or "convolution(" in ln or n.startswith("conv_"):
-        return "conv"
-    if ("dot(" in ln or "dot " in ln or "gemm" in n or "gemm" in c
-            or c == "dot" or n.startswith("dot")):
-        return "gemm"
-    # layout/data-movement BEFORE elementwise: convert_element_type is
-    # a dtype/layout relayout even though XLA categorizes it
-    # "non-fusion elementwise", and the async slice-start/done pairs
-    # are HBM<->scratch staging copies
-    if (c in ("copy", "copy-start", "copy-done", "data formatting",
-              "dynamic-slice", "async-start", "async-done")
-            or n.startswith(_LAYOUT_NAME_PREFIXES)):
-        return "layout"
-    if ("fusion" in c or "elementwise" in c or "reduce" in c
-            or "scatter" in c or "select-and-scatter" in c
-            or n.startswith(("fusion", "add", "multiply", "reduce",
-                             "select_and_scatter", "broadcast"))):
-        return "bn_elementwise"
-    return "other"
 
 
 def _load_trace(path: str) -> dict:
@@ -303,187 +250,6 @@ def analyze(path: str, top: int = 10) -> dict:
     # to the trace as <stem>.report.json — fold it in for context
     stem = path
     for suf in (".trace.json.gz", ".trace.json", ".json.gz", ".json"):
-        if stem.endswith(suf):
-            stem = stem[: -len(suf)]
-            break
-    sibling = stem + ".report.json"
-    if os.path.exists(sibling):
-        with open(sibling) as f:
-            report["capture_report"] = json.load(f)
-    return report
-
-
-_DTYPE_BYTES = {
-    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-    "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8,
-    "u64": 8, "f64": 8, "c64": 8, "c128": 16, "f8e4m3fn": 1,
-    "f8e5m2": 1,
-}
-_SHAPE_RE = re.compile(
-    r"\b(" + "|".join(_DTYPE_BYTES) + r")\[([0-9,]*)\]"
-)
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"      # instruction name
-    r"((?:\([^=]*?\))|\S+)\s+"                   # output shape (or tuple)
-    r"([\w\-]+)\("                               # opcode
-)
-# instructions that move no HBM bytes of their own: reads are charged
-# at the consuming op, parameters/constants at their users, tuple
-# plumbing is free
-_FREE_OPCODES = {
-    "parameter", "constant", "get-tuple-element", "tuple", "bitcast",
-    "after-all", "partition-id", "replica-id", "iota",
-}
-
-
-def _shape_bytes(text: str) -> int:
-    """Total bytes of every dtype[shape] occurrence in `text` (tuples
-    sum their elements; scalars count their dtype size)."""
-    total = 0
-    for dt, dims in _SHAPE_RE.findall(text):
-        n = 1
-        if dims:
-            for d in dims.split(","):
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
-
-
-def _operand_section(rest: str) -> str:
-    """`rest` starts right after the opcode's '(' — return the operand
-    text up to its matching ')' (attributes/metadata excluded)."""
-    depth = 1
-    for i, ch in enumerate(rest):
-        if ch == "(":
-            depth += 1
-        elif ch == ")":
-            depth -= 1
-            if depth == 0:
-                return rest[:i]
-    return rest
-
-
-# categories with a positive token/opcode signal; the fallback buckets
-# (bn_elementwise / layout / other) are WEAK — a weak op whose operand
-# was produced by an attention op inherits "attention" (dataflow
-# closure). XLA's backward-pass fission drops metadata from some
-# fusions (e.g. the [T,T] softmax-backward convert fusions in the
-# dense longctx capture carry no op_name at all), and without the
-# closure those score-matrix bytes silently leak into bn_elementwise.
-_STRONG_CATEGORIES = ("collective", "infeed", "attention", "conv",
-                      "gemm")
-_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
-
-
-def analyze_hlo(path: str, top: int = 10) -> dict:
-    """Static byte attribution of one compiled HLO module (the
-    `*.hlo.txt[.gz]` captures): each top-level instruction is charged
-    its output + operand bytes — at fusion granularity, exactly the
-    tensors that cross HBM — and bucketed with the same classify() as
-    the trace path (plus the weak-op dataflow inheritance above).
-    Instructions inside %fused_computation bodies are skipped (they
-    live in registers/scratch); other non-entry computations (while
-    bodies, reduce appliers) count once, with the while-instruction
-    count reported so the caveat is visible."""
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:
-        lines = f.read().splitlines()
-
-    cat_bytes = defaultdict(int)
-    cat_ops = defaultdict(int)
-    by_name = {}
-    prod_cat: dict = {}  # instruction -> category (dataflow closure)
-    total = 0
-    n_instr = 0
-    n_while = 0
-    largest_output = 0
-    inherited = 0
-    in_fused = False
-    depth_at_fused = 0
-    brace_depth = 0
-    for line in lines:
-        stripped = line.strip()
-        opens = line.count("{") - line.count("}")
-        if not in_fused and (
-            stripped.startswith("%fused_computation")
-            or stripped.startswith("fused_computation")
-        ) and "{" in line:
-            in_fused = True
-            depth_at_fused = brace_depth
-        brace_depth += opens
-        if in_fused:
-            if brace_depth <= depth_at_fused:
-                in_fused = False
-            continue
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        name, out_shape, opcode = m.groups()
-        if opcode in _FREE_OPCODES:
-            continue
-        n_instr += 1
-        if opcode == "while":
-            n_while += 1
-        rest = line[m.end():]
-        operands = _operand_section(rest)
-        out_bytes = _shape_bytes(out_shape)
-        largest_output = max(largest_output, out_bytes)
-        nbytes = out_bytes + _shape_bytes(operands)
-        cat = classify(name, opcode, line)
-        if cat not in _STRONG_CATEGORIES:
-            for op_name in _OPERAND_NAME_RE.findall(operands):
-                if prod_cat.get(op_name) == "attention":
-                    cat = "attention"
-                    inherited += 1
-                    break
-        prod_cat[name] = cat
-        cat_bytes[cat] += nbytes
-        cat_ops[cat] += 1
-        total += nbytes
-        rec = by_name.setdefault(
-            name, {"name": name, "category": cat, "bytes": 0,
-                   "count": 0},
-        )
-        rec["bytes"] += nbytes
-        rec["count"] += 1
-
-    if n_instr == 0:
-        raise SystemExit(f"{path}: no HLO instructions found")
-
-    categories = {}
-    for cat in CATEGORIES:
-        if cat_ops.get(cat, 0) == 0:
-            continue
-        categories[cat] = {
-            "bytes": cat_bytes[cat],
-            "share": round(cat_bytes[cat] / total, 4) if total else 0.0,
-            "n_ops": cat_ops[cat],
-        }
-    top_hlos = sorted(by_name.values(), key=lambda r: -r["bytes"])[:top]
-    for r in top_hlos:
-        r["share_of_bytes"] = round(r["bytes"] / total, 4) if total \
-            else 0.0
-
-    report = {
-        "source": os.path.basename(path),
-        "capture_kind": "hlo_module",
-        "total_bytes": total,
-        "n_instructions": n_instr,
-        # while bodies are charged ONCE; a loopy capture must fold its
-        # trip count in by hand (the decode analysis multiplies by
-        # max_len) — 0 means the byte table is exact
-        "while_instructions": n_while,
-        # the footprint pin: the biggest single tensor the program
-        # materializes (dense longctx: the [B,H,T,T] scores; flash:
-        # a [B,H,T,block_k] tile)
-        "largest_output_bytes": largest_output,
-        "attention_inherited_ops": inherited,
-        "shares": {c: v["share"] for c, v in categories.items()},
-        "categories": categories,
-        "top_hlos": top_hlos,
-    }
-    stem = path
-    for suf in (".hlo.txt.gz", ".hlo.txt"):
         if stem.endswith(suf):
             stem = stem[: -len(suf)]
             break
