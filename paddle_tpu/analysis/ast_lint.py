@@ -27,6 +27,16 @@ tree. Each pass encodes a rule the repo learned the hard way:
   (ROADMAP 5d) kept re-finding in bench code. Trainer-style
   self-fencing APIs (run_step fetches the loss) are not flagged: the
   pass tracks only locally-bound jit objects.
+- **raw_collective_outside_shard_map** — `lax.psum` / `ppermute` /
+  `all_to_all` / `all_gather` are only meaningful over a named mesh
+  axis, i.e. inside a function that flows into `core.mesh.shard_map`.
+  A raw collective in ordinary jit code either crashes on an unbound
+  axis name or — under an enclosing pmap/shard_map it was never
+  written for — silently reduces over the WRONG axis. The pass roots
+  at every function passed to a `*shard_map` call and closes over
+  same-file name references and lexical nesting; anything else that
+  calls a raw collective fails. A deliberate exception carries a
+  `# lint: raw-collective-ok` pragma saying why.
 - **unlocked_mutation** — in a class that owns a `self._lock`,
   mutating a container attribute (one assigned `{}`/`[]`/`deque()`/
   `set()` in `__init__`) outside a `with self._lock`/`self._work`
@@ -80,6 +90,11 @@ _MUTATORS = {
 _CONTAINER_CTORS = {"dict", "list", "set", "deque", "defaultdict",
                     "OrderedDict", "Counter"}
 _PRAGMA = "lint: unlocked-ok"
+
+# ---- raw_collective_outside_shard_map configuration ---------------
+_RAW_COLLECTIVES = {"psum", "ppermute", "all_to_all", "all_gather",
+                    "pmean", "psum_scatter"}
+_COLLECTIVE_PRAGMA = "lint: raw-collective-ok"
 
 
 def iter_py_files(repo_dir: str, subpaths=("paddle_tpu",)):
@@ -390,11 +405,176 @@ def check_unlocked_mutation(repo_dir: str) -> list:
     return violations
 
 
+# ---- pass: raw_collective_outside_shard_map -----------------------
+_FN_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _index_functions(tree):
+    """(fn_node -> enclosing fn_node | None) for every def/lambda."""
+    parent = {}
+
+    def walk(node, enclosing):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FN_NODES):
+                parent[child] = enclosing
+                walk(child, child)
+            else:
+                walk(child, enclosing)
+
+    walk(tree, None)
+    return parent
+
+
+def _is_raw_collective(node):
+    """`lax.psum(...)` / `jax.lax.psum(...)` / bare `psum(...)` after
+    `from jax.lax import psum`. Bare names are only trusted when the
+    attribute chain is absent — a method named .psum on some other
+    object still counts (no framework object has one; erring loud)."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _RAW_COLLECTIVES:
+        v = f.value
+        if (isinstance(v, ast.Name) and v.id == "lax") or (
+            isinstance(v, ast.Attribute) and v.attr == "lax"
+        ):
+            return f.attr
+    if isinstance(f, ast.Name) and f.id in _RAW_COLLECTIVES:
+        return f.id
+    return None
+
+
+def _shard_map_roots(tree):
+    """Function nodes / names handed to a `*shard_map(...)` call:
+    direct `shard_map(f, ...)` args, inline lambdas, and
+    `partial(f, ...)` wrappers."""
+    root_nodes, root_names = set(), set()
+
+    def claim(arg):
+        if isinstance(arg, ast.Lambda):
+            root_nodes.add(arg)
+        elif isinstance(arg, ast.Name):
+            root_names.add(arg.id)
+        elif (isinstance(arg, ast.Call)
+              and _call_name(arg) == "partial" and arg.args):
+            claim(arg.args[0])
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node) or ""
+        if not name.endswith("shard_map"):
+            continue
+        for arg in node.args:
+            claim(arg)
+        for kw in node.keywords:
+            if kw.arg == "f":
+                claim(kw.value)
+    return root_nodes, root_names
+
+
+def check_raw_collective_outside_shard_map(repo_dir: str) -> list:
+    violations = []
+    for path in iter_py_files(repo_dir):
+        rel = os.path.relpath(path, repo_dir)
+        tree, src = _parse(path)
+        lines = src.splitlines()
+
+        def suppressed(lineno):
+            for ln in (lineno, lineno - 1):
+                if (1 <= ln <= len(lines)
+                        and _COLLECTIVE_PRAGMA in lines[ln - 1]):
+                    return True
+            return False
+
+        # any raw collective in the file at all? (cheap early-out)
+        hits = [
+            (n, _is_raw_collective(n)) for n in ast.walk(tree)
+            if _is_raw_collective(n)
+        ]
+        if not hits:
+            continue
+
+        parent = _index_functions(tree)
+        root_nodes, root_names = _shard_map_roots(tree)
+        by_name = {}
+        for fn in parent:
+            if not isinstance(fn, ast.Lambda):
+                by_name.setdefault(fn.name, []).append(fn)
+
+        covered = set(root_nodes)
+        for nm in root_names:
+            covered.update(by_name.get(nm, []))
+        # fixpoint over two edge kinds: (a) lexical nesting — a def
+        # inside a covered function runs under the same shard_map
+        # (lax.cond/fori_loop branch callbacks); (b) same-file name
+        # REFERENCE from a covered body — `local` calling (or merely
+        # passing along) `_ring_body` extends the covered region.
+        changed = True
+        while changed:
+            changed = False
+            for fn, enc in parent.items():
+                if fn not in covered and enc in covered:
+                    covered.add(fn)
+                    changed = True
+            for fn in list(covered):
+                for n in ast.walk(fn):
+                    if not isinstance(n, ast.Name):
+                        continue
+                    for target in by_name.get(n.id, ()):
+                        if target not in covered:
+                            covered.add(target)
+                            changed = True
+
+        def enclosing(node):
+            """Innermost fn the call sits in (parents map has only
+            fn->fn edges, so walk the tree for the chain)."""
+            chain = []
+
+            def down(cur, stack):
+                for child in ast.iter_child_nodes(cur):
+                    if child is node:
+                        chain.extend(stack)
+                        return True
+                    nxt = stack + [child] if isinstance(
+                        child, _FN_NODES
+                    ) else stack
+                    if down(child, nxt):
+                        return True
+                return False
+
+            down(tree, [])
+            return chain[-1] if chain else None
+
+        for call, kind in hits:
+            if suppressed(call.lineno):
+                continue
+            fn = enclosing(call)
+            if fn is not None and fn in covered:
+                continue
+            where = (
+                "module scope" if fn is None else
+                (fn.name if not isinstance(fn, ast.Lambda)
+                 else f"<lambda>:{fn.lineno}") + "()"
+            )
+            violations.append(
+                f"{rel}:{call.lineno}: raw lax.{kind} in {where} "
+                f"which never flows into shard_map — the axis name "
+                f"is unbound (or bound to the WRONG mesh axis under "
+                f"someone else's pmap); wrap the caller in "
+                f"core.mesh.shard_map or justify with "
+                f"`# {_COLLECTIVE_PRAGMA}`"
+            )
+    return violations
+
+
 PASSES = {
     "jax_import_fence": check_jax_import_fence,
     "duplicate_dict_keys": check_duplicate_dict_keys,
     "unfenced_timing": check_unfenced_timing,
     "unlocked_mutation": check_unlocked_mutation,
+    "raw_collective_outside_shard_map":
+        check_raw_collective_outside_shard_map,
 }
 
 

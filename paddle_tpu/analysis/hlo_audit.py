@@ -241,7 +241,8 @@ def audit_capture(hlo_path: str, policy: dict,
                 report = json.load(f)
 
     text, instrs = _instructions(hlo_path)
-    attrib = _hlo.analyze_hlo(hlo_path, lines=text.splitlines())
+    lines = text.splitlines()
+    attrib = _hlo.analyze_hlo(hlo_path, lines=lines)
     checks = []
     if policy.get("require_donation"):
         checks.append(check_donation(text, policy, report))
@@ -254,7 +255,7 @@ def audit_capture(hlo_path: str, policy: dict,
         )
     if policy.get("forbid_f32_upcast"):
         checks.append(check_no_f32_upcast(instrs, policy))
-    return {
+    out = {
         "schema": AUDIT_SCHEMA,
         "source": os.path.basename(hlo_path),
         "attn_impl": report.get("attn_impl"),
@@ -262,9 +263,24 @@ def audit_capture(hlo_path: str, policy: dict,
         "n_instructions": attrib["n_instructions"],
         "total_bytes": attrib["total_bytes"],
         "largest_output_bytes": attrib["largest_output_bytes"],
-        "ok": all(c["ok"] for c in checks),
-        "checks": checks,
     }
+    # SPMD policies (ISSUE 15): partitioning/replication/collective/
+    # schedule checks ride the SAME report + freshness machinery —
+    # one <stem>.audit.json per capture, never two writers. The extra
+    # keys appear only on SPMD policies so the single-device reports
+    # stay byte-identical.
+    from paddle_tpu.analysis import spmd_audit as _spmd
+
+    if _spmd.is_spmd_policy(policy):
+        spmd_checks, summary = _spmd.spmd_checks(
+            text, policy, lines=lines
+        )
+        checks.extend(spmd_checks)
+        out["num_partitions"] = _hlo.num_partitions(text)
+        out["collectives"] = summary
+    out["ok"] = all(c["ok"] for c in checks)
+    out["checks"] = checks
+    return out
 
 
 def load_budgets(path: str) -> dict:
@@ -272,11 +288,14 @@ def load_budgets(path: str) -> dict:
         return json.load(f)
 
 
-def audit_dir(traces_dir: str, budgets_path: str = None) -> dict:
+def audit_dir(traces_dir: str, budgets_path: str = None,
+              only=None) -> dict:
     """Audit every capture named in the budgets file. Returns
     {stem: report}. A budget entry whose capture file is missing is
     itself a violation (reported as a failed pseudo-check): deleting
-    an audited capture must not silently drop its tripwires."""
+    an audited capture must not silently drop its tripwires.
+    `only` is an optional predicate on the policy dict — the
+    spmd-audit pass uses it to run exactly the SPMD-policy stems."""
     budgets_path = budgets_path or os.path.join(
         traces_dir, "audit_budgets.json"
     )
@@ -284,6 +303,8 @@ def audit_dir(traces_dir: str, budgets_path: str = None) -> dict:
     out = {}
     for stem, policy in sorted(budgets.items()):
         if stem.startswith("_"):  # "_comment" etc.
+            continue
+        if only is not None and not only(policy):
             continue
         hlo_path = os.path.join(traces_dir, stem + ".hlo.txt.gz")
         if not os.path.exists(hlo_path):

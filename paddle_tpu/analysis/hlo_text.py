@@ -107,7 +107,10 @@ _SHAPE_RE = re.compile(
 )
 _INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"      # instruction name
-    r"((?:\([^=]*?\))|\S+)\s+"                   # output shape (or tuple)
+    r"((?:\([^()]*\))|\S+)\s+"                   # output shape (or tuple;
+    # tuple shapes nest no parens but DO carry /*index=N*/ comments
+    # from 6 elements up — a [^=] shape matcher loses every big-carry
+    # while loop and tuple-form all-to-all)
     r"([\w\-]+)\("                               # opcode
 )
 # instructions that move no HBM bytes of their own: reads are charged
@@ -342,3 +345,281 @@ def analyze_hlo(path: str, top: int = 10, lines=None) -> dict:
         with open(sibling) as f:
             report["capture_report"] = json.load(f)
     return report
+
+
+# ==== SPMD parsing (ISSUE 15) ======================================
+# Partitioned-module structure the SPMD auditor
+# (analysis/spmd_audit.py) and the runtime multi-chip gate
+# (parallel/dp.py assert_collectives) argue about: `sharding={...}`
+# annotations, the collective instructions with their replica groups /
+# channel ids / permute pairs, and which computation each instruction
+# lives in (collectives appear inside while bodies and conditional
+# branch regions — the ring attention hop is a collective-permute
+# inside a branch inside the ring while loop).
+
+# canonical collective opcodes; async pairs normalize to the base kind
+# and only the -start half is yielded (the -done moves no new bytes)
+COLLECTIVE_KINDS = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute", "collective-broadcast",
+)
+
+_NUM_PARTITIONS_RE = re.compile(r"\bnum_partitions=(\d+)\b")
+_CHANNEL_ID_RE = re.compile(r"\bchannel_id=(\d+)\b")
+_GROUP_LIST_RE = re.compile(r"\{([0-9,\s]*)\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"\[([0-9,]+)\]<=\[([0-9,]+)\]"
+)
+# computation definition lines: `%name (params...) -> shape {` with an
+# optional leading ENTRY; fused computations match too (collectives
+# never fuse today, but the walker must not silently lose one if a
+# future runtime puts them there)
+_COMP_DEF_RE = re.compile(r"^\s*(?:ENTRY\s+)?%([\w.\-]+)\s*\(")
+
+
+def num_partitions(text: str) -> int:
+    """The module header's partition count — 1 (or absent) means the
+    program was NOT SPMD-partitioned; an audited sharded capture with
+    num_partitions=1 silently ran single-device."""
+    m = _NUM_PARTITIONS_RE.search(module_header(text))
+    return int(m.group(1)) if m else 1
+
+
+def _balanced_braces(text: str, start: int) -> str:
+    """`text[start]` is '{' — return the body between it and its
+    matching '}' (exclusive)."""
+    depth = 0
+    for j in range(start, len(text)):
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start + 1:j]
+    return text[start + 1:]
+
+
+def _split_top_level(body: str) -> list:
+    """Split `a, b, c` at depth-0 commas (sub-braces kept intact)."""
+    parts, depth, cur = [], 0, []
+    for ch in body:
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    tail = "".join(cur).strip()
+    if tail:
+        parts.append(tail)
+    return parts
+
+
+def _parse_sharding_body(body: str) -> dict:
+    """`body` is the text INSIDE the annotation's outer braces."""
+    body = body.strip()
+    if body.startswith("{"):
+        # tuple sharding: one `{...}` element per tuple leaf, in order
+        return {
+            "kind": "tuple",
+            "elements": [
+                _parse_sharding_body(_balanced_braces(e, e.find("{")))
+                for e in _split_top_level(body)
+            ],
+        }
+    if body == "replicated":
+        return {"kind": "replicated"}
+    if body == "manual":
+        return {"kind": "manual"}
+    if body.startswith("maximal"):
+        m = re.search(r"device=(\d+)", body)
+        return {
+            "kind": "maximal",
+            "device": int(m.group(1)) if m else 0,
+        }
+    if body.startswith("devices="):
+        m = re.match(r"devices=\[([0-9,]+)\]", body)
+        tile = [int(d) for d in m.group(1).split(",")] if m else []
+        return {
+            "kind": "devices",
+            "tile": tile,
+            "last_tile_dim_replicate":
+                "last_tile_dim_replicate" in body,
+        }
+    return {"kind": "other", "raw": body}
+
+
+def parse_sharding(line: str):
+    """The `sharding={...}` annotation on one instruction line, as a
+    dict — kind 'replicated' | 'maximal' | 'devices' (with the tile
+    assignment dims) | 'tuple' (per-leaf elements) | 'manual' — or
+    None when the line carries no annotation."""
+    i = line.find("sharding=")
+    if i < 0:
+        return None
+    j = line.find("{", i)
+    if j < 0:
+        return None
+    return _parse_sharding_body(_balanced_braces(line, j))
+
+
+def sharding_is_replicated(sh: dict) -> bool:
+    """True when the annotation pins FULL bytes on every device: plain
+    replicated, maximal (one device holds the whole tensor), or a
+    devices= tiling whose non-replication dims are all 1."""
+    if sh is None:
+        return False
+    kind = sh.get("kind")
+    if kind in ("replicated", "maximal"):
+        return True
+    if kind == "devices":
+        tile = sh.get("tile") or []
+        if sh.get("last_tile_dim_replicate"):
+            tile = tile[:-1]
+        return all(d == 1 for d in tile)
+    return False
+
+
+def iter_computations(lines):
+    """Yield (computation_name, line) for every line, tracking which
+    computation definition the walker is inside (fused bodies
+    included — unlike iter_instructions, nothing is skipped)."""
+    comp = ""
+    for line in lines:
+        m = _COMP_DEF_RE.match(line)
+        if m and "->" in line and line.rstrip().endswith("{"):
+            comp = m.group(1)
+        yield comp, line
+
+
+def iter_shardings(lines):
+    """Yield (name, out_shape, sharding, computation) for every
+    instruction carrying a `sharding={...}` annotation, across ALL
+    computations (entry params, outputs, copies)."""
+    for comp, line in iter_computations(lines):
+        if "sharding=" not in line:
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            # parameters have no '(': `%p = f32[8]{0} parameter(0), ...`
+            # — they DO match _INSTR_RE (opcode `parameter(`); anything
+            # else with a sharding but no instruction form is skipped
+            continue
+        name, out_shape, _opcode = m.groups()
+        sh = parse_sharding(line)
+        if sh is not None:
+            yield name, out_shape, sh, comp
+
+
+def _parse_replica_groups(line: str):
+    """`replica_groups={{0,1},{2,3}}` -> [[0,1],[2,3]]; the iota form
+    `replica_groups=[2,4]<=[8]` expands row-major when untransposed
+    (the transposed form is kept raw — no capture uses it today)."""
+    i = line.find("replica_groups=")
+    if i < 0:
+        return []
+    rest = line[i + len("replica_groups="):]
+    if rest.startswith("{"):
+        body = _balanced_braces(rest, 0)
+        return [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in _GROUP_LIST_RE.findall("{" + body + "}")
+        ]
+    m = _IOTA_GROUPS_RE.match(rest)
+    if m:
+        dims = [int(d) for d in m.group(1).split(",")]
+        n = 1
+        for d in [int(d) for d in m.group(2).split(",")]:
+            n *= d
+        if len(dims) == 2 and dims[0] * dims[1] == n \
+                and not rest[m.end():m.end() + 1] == "T":
+            return [
+                list(range(r * dims[1], (r + 1) * dims[1]))
+                for r in range(dims[0])
+            ]
+    return []
+
+
+def _parse_pairs(line: str):
+    """`source_target_pairs={{0,1},{1,2}}` -> [(0,1),(1,2)]."""
+    i = line.find("source_target_pairs=")
+    if i < 0:
+        return []
+    body = _balanced_braces(line, line.find("{", i))
+    out = []
+    for g in _GROUP_LIST_RE.findall("{" + body + "}"):
+        xs = [int(x) for x in g.split(",") if x.strip()]
+        if len(xs) == 2:
+            out.append((xs[0], xs[1]))
+    return out
+
+
+def parse_collectives(lines) -> list:
+    """Every collective instruction in the module, across ALL
+    computations (while bodies, conditional branches, fusion bodies),
+    as dicts:
+
+      {name, kind, opcode, out_shape, bytes, channel_id,
+       replica_groups, source_target_pairs, computation, operands}
+
+    `kind` normalizes async pairs (`all-gather-start` -> all-gather);
+    only the -start half is recorded. `bytes` is the instruction's
+    output bytes — for a tuple-shaped all-to-all the sum over
+    elements — i.e. what one program execution moves through the
+    fabric per device. `channel_id` is None for unchanneled
+    (replica-mode) collectives."""
+    out = []
+    for comp, line in iter_computations(lines):
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, out_shape, opcode = m.groups()
+        base = opcode
+        for suf in ("-start", "-done"):
+            if base.endswith(suf):
+                base = base[: -len(suf)]
+        if base not in COLLECTIVE_KINDS:
+            continue
+        if opcode.endswith("-done"):
+            continue
+        cm = _CHANNEL_ID_RE.search(line)
+        rest = line[m.end():]
+        out.append({
+            "name": name,
+            "kind": base,
+            "opcode": opcode,
+            "out_shape": out_shape,
+            "bytes": shape_bytes(out_shape),
+            "channel_id": int(cm.group(1)) if cm else None,
+            "replica_groups": _parse_replica_groups(line),
+            "source_target_pairs": _parse_pairs(line),
+            "computation": comp,
+            "operands": operand_section(rest),
+        })
+    return out
+
+
+def collective_summary(collectives) -> dict:
+    """Aggregate byte/count view of `parse_collectives` output — the
+    numbers the collective byte budget is enforced against."""
+    by_kind: dict = {}
+    total = 0
+    largest = 0
+    largest_name = ""
+    for c in collectives:
+        k = by_kind.setdefault(c["kind"], {"count": 0, "bytes": 0})
+        k["count"] += 1
+        k["bytes"] += c["bytes"]
+        total += c["bytes"]
+        if c["bytes"] > largest:
+            largest, largest_name = c["bytes"], c["name"]
+    return {
+        "count": len(collectives),
+        "total_bytes": total,
+        "largest_bytes": largest,
+        "largest": largest_name,
+        "by_kind": by_kind,
+    }

@@ -1,0 +1,408 @@
+"""SPMD partitioning & collective-schedule auditor (ISSUE 15
+tentpole) — the third leg of the static-analysis subsystem after the
+source passes (ast_lint) and the single-program audits (hlo_audit).
+
+A sharded program that silently stops being sharded still RUNS — XLA
+happily repartitions, replicates the table, or swaps a reduce-scatter
+for a full all-gather, and the only symptom is bytes. These checks
+turn "partitioned" into a machine-checked property of the committed
+`mc_*` captures:
+
+- **replication budget** — on a capture whose policy names it
+  sharded, no tensor above `replication_floor_bytes` may carry a
+  replicated/maximal sharding annotation. The sparse table and the
+  T>=32k attention operands must shrink per device; small replicated
+  weights are fine below the floor.
+- **collective byte budget** — total collective bytes and the largest
+  single collective vs the committed baseline + headroom
+  (`collective_total_bytes_max` / `largest_collective_bytes_max`),
+  plus required/forbidden collective kinds: a repartition that swaps
+  the ring permute for a full all-gather of the sequence fails even
+  when the byte total happens to squeak under.
+- **schedule safety** — the static deadlock tripwires:
+  (1) a channel_id may name at most ONE collective (two collectives
+  matched on one channel is the classic mismatched-rendezvous hang);
+  (2) within a computation, channel order must agree with data flow —
+  if collective B transitively consumes collective A's result, then
+  channel_id(A) < channel_id(B). Data flow forces A to execute first
+  on every rank; a lower channel on B means a rank whose runtime
+  matches channels in order waits on B first — rank-divergent
+  schedules, the classic SPMD deadlock. (Independent collectives may
+  be legally reordered by the scheduler — real captures DO interleave
+  them out of channel order, so the check is deliberately limited to
+  data-dependent chains.)
+  (3) every collective-permute's source-target pairs form a valid
+  partial permutation (distinct sources, distinct targets), and under
+  `require_single_ring` exactly one cycle covering every participant
+  — the ring invariant of ring attention / pipeline hops. Two
+  disjoint half-rings ship the same bytes and deadlock the online
+  softmax's global reduction.
+
+Driven per capture by the same `tools/traces/audit_budgets.json`
+policies as hlo_audit — a policy carrying any SPMD_POLICY_KEYS gets
+these checks appended to its `<stem>.audit.json` report, and the
+`spmd-audit` framework_lint pass runs exactly those stems.
+
+Pure stdlib, jax-free, like every analysis/ module.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.analysis import hlo_text as _hlo
+
+# a policy with any of these keys is an SPMD policy: its capture gets
+# the partitioning/collective/schedule checks and is picked up by the
+# `spmd-audit` framework_lint pass
+SPMD_POLICY_KEYS = (
+    "num_partitions",
+    "replication_floor_bytes",
+    "allow_replicated",
+    "collective_total_bytes_max",
+    "largest_collective_bytes_max",
+    "require_collectives",
+    "forbid_collectives",
+    "require_single_ring",
+)
+
+
+def is_spmd_policy(policy: dict) -> bool:
+    return any(k in policy for k in SPMD_POLICY_KEYS)
+
+
+# ---- check family (pre): the module really is partitioned ----------
+def check_partitioning(text: str, policy: dict) -> dict:
+    """`num_partitions` in the module header must match the mesh the
+    capture claims — a sharded capture recompiled single-device would
+    pass every other check vacuously (no shardings, no collectives)."""
+    need = int(policy.get("num_partitions", 0))
+    got = _hlo.num_partitions(text)
+    ok = got == need
+    return {
+        "name": "spmd.partitioning",
+        "ok": ok,
+        "num_partitions": got,
+        "expected": need,
+        "detail": (
+            "" if ok else
+            f"module header says num_partitions={got}, the policy "
+            f"pins {need} — this capture did not compile onto the "
+            f"mesh it claims, every other SPMD check is vacuous"
+        ),
+    }
+
+
+# ---- check family (a): replication budget --------------------------
+def _tuple_shape_parts(out_shape: str) -> list:
+    """Per-leaf (dtype, dims) of a tuple shape, in leaf order."""
+    return _hlo.shape_dims(out_shape)
+
+
+def check_replication(lines, policy: dict) -> dict:
+    """No tensor above the size floor may carry a replicated/maximal
+    sharding annotation. Shapes in a partitioned module are LOCAL
+    (per-device), so a replicated annotation means the full global
+    bytes sit on every chip — exactly the repartition this exists to
+    catch (the 100M-row table all-gathered back together)."""
+    floor = int(policy.get("replication_floor_bytes", 1 << 20))
+    allow = set(policy.get("allow_replicated", []))
+    offenders = []
+    for name, out_shape, sh, comp in _hlo.iter_shardings(lines):
+        if name in allow:
+            continue
+        if sh.get("kind") == "tuple":
+            parts = _tuple_shape_parts(out_shape)
+            els = sh.get("elements", [])
+            for i, el in enumerate(els):
+                if not _hlo.sharding_is_replicated(el):
+                    continue
+                if i >= len(parts):
+                    continue
+                dt, dims = parts[i]
+                n = 1
+                for d in dims:
+                    n *= d
+                nbytes = n * _hlo._DTYPE_BYTES[dt]
+                if nbytes >= floor:
+                    offenders.append(
+                        f"{name}[{i}] {dt}{dims} ({nbytes} B) in "
+                        f"{comp}"
+                    )
+            continue
+        if not _hlo.sharding_is_replicated(sh):
+            continue
+        nbytes = _hlo.shape_bytes(out_shape)
+        if nbytes >= floor:
+            offenders.append(
+                f"{name} {out_shape} ({nbytes} B) in {comp}"
+            )
+    ok = not offenders
+    return {
+        "name": "spmd.replication",
+        "ok": ok,
+        "floor_bytes": floor,
+        "offenders": offenders[:6],
+        "detail": (
+            "" if ok else
+            f"{len(offenders)} tensor(s) >= {floor / 1e6:.1f} MB "
+            f"carry a replicated/maximal sharding on a capture whose "
+            f"policy names it sharded: {offenders[:3]} — the full "
+            f"bytes sit on EVERY device; the partitioning silently "
+            f"dropped"
+        ),
+    }
+
+
+# ---- check family (b): collective byte budget ----------------------
+def check_collective_bytes(summary: dict, policy: dict) -> list:
+    """Total / largest collective bytes vs the committed baseline +
+    headroom, plus the required/forbidden kind lists."""
+    checks = []
+    for field, key in (
+        ("total_bytes", "collective_total_bytes_max"),
+        ("largest_bytes", "largest_collective_bytes_max"),
+    ):
+        cap = policy.get(key)
+        if cap is None:
+            continue
+        got = summary[field]
+        ok = got <= cap
+        checks.append({
+            "name": f"spmd.collective_{field}",
+            "ok": ok,
+            "measured": got,
+            "budget": cap,
+            "detail": (
+                "" if ok else
+                f"collective {field}={got / 1e6:.2f} MB exceeds the "
+                f"committed budget {cap / 1e6:.2f} MB — the program "
+                f"moves more fabric bytes than the baseline it was "
+                f"committed with (a repartition/over-gather crept in)"
+            ),
+        })
+    by_kind = summary["by_kind"]
+    for kind in policy.get("require_collectives", []):
+        ok = by_kind.get(kind, {}).get("count", 0) > 0
+        checks.append({
+            "name": f"spmd.require.{kind}",
+            "ok": ok,
+            "detail": (
+                "" if ok else
+                f"no {kind} in the compiled module — the sharding "
+                f"this capture exists to prove was dropped (the "
+                f"program runs fine fully replicated; bytes are the "
+                f"only witness)"
+            ),
+        })
+    for kind in policy.get("forbid_collectives", []):
+        got = by_kind.get(kind, {})
+        ok = got.get("count", 0) == 0
+        checks.append({
+            "name": f"spmd.forbid.{kind}",
+            "ok": ok,
+            "count": got.get("count", 0),
+            "bytes": got.get("bytes", 0),
+            "detail": (
+                "" if ok else
+                f"{got.get('count')} {kind} op(s) moving "
+                f"{got.get('bytes', 0) / 1e6:.2f} MB — this capture "
+                f"must not {kind} (the over-gather repartition: e.g. "
+                f"a reduce-scatter swapped for a full all-gather)"
+            ),
+        })
+    return checks
+
+
+# ---- check family (c): schedule safety -----------------------------
+def _computation_ancestry(lines, collectives):
+    """For every channel-bearing collective, the set of channel-bearing
+    collectives whose results it transitively consumes (within its
+    computation; HLO text is def-before-use, so one forward pass).
+    Returns [(ancestor, descendant), ...] collective-record pairs."""
+    chan = {
+        c["name"]: c for c in collectives
+        if c["channel_id"] is not None
+    }
+    pairs = []
+    anc: dict = {}
+    cur_comp = None
+    for comp, line in _hlo.iter_computations(lines):
+        if comp != cur_comp:
+            cur_comp = comp
+            anc = {}
+        m = _hlo._INSTR_RE.match(line)
+        if not m:
+            continue
+        name = m.group(1)
+        operands = _hlo.operand_section(line[m.end():])
+        up: set = set()
+        for op in _hlo._OPERAND_NAME_RE.findall(operands):
+            up |= anc.get(op, set())
+            if op in chan:
+                up.add(op)
+        anc[name] = up
+        if name in chan:
+            for a in up:
+                pairs.append((chan[a], chan[name]))
+    return pairs
+
+
+def check_channel_unique(collectives) -> dict:
+    """One channel_id, one collective: two instructions matched on the
+    same channel is a mismatched rendezvous — ranks can pair opposite
+    ops and wait forever."""
+    seen: dict = {}
+    dups = []
+    for c in collectives:
+        ch = c["channel_id"]
+        if ch is None:
+            continue
+        if ch in seen:
+            dups.append(
+                f"channel {ch}: {seen[ch]} and {c['name']}"
+            )
+        else:
+            seen[ch] = c["name"]
+    ok = not dups
+    return {
+        "name": "spmd.schedule.channel_unique",
+        "ok": ok,
+        "channels": len(seen),
+        "detail": (
+            "" if ok else
+            f"duplicate channel_id(s): {dups[:3]} — two collectives "
+            f"share a rendezvous channel; ranks can match opposite "
+            f"ops and deadlock"
+        ),
+    }
+
+
+def check_channel_order(lines, collectives) -> dict:
+    """Channel order must agree with data flow: if collective B
+    consumes collective A's result, channel_id(A) < channel_id(B).
+    Data dependence fixes the execution order on every rank; a lower
+    channel on the LATER op means a runtime that services channels in
+    order rendezvouses on B first — the rank-divergent schedule that
+    hangs a pod. Independent collectives are exempt on purpose: real
+    schedulers interleave them out of channel order legally."""
+    bad = []
+    for a, b in _computation_ancestry(lines, collectives):
+        if a["channel_id"] >= b["channel_id"]:
+            bad.append(
+                f"{b['name']} (ch {b['channel_id']}) data-depends on "
+                f"{a['name']} (ch {a['channel_id']}) in "
+                f"{b['computation']}"
+            )
+    ok = not bad
+    return {
+        "name": "spmd.schedule.channel_order",
+        "ok": ok,
+        "detail": (
+            "" if ok else
+            f"{len(bad)} collective pair(s) whose channel order "
+            f"contradicts data flow: {bad[:3]} — the dependency "
+            f"forces one execution order while the channel numbers "
+            f"promise another; rank-divergent rendezvous = deadlock"
+        ),
+    }
+
+
+def _cycles(pairs):
+    """Decompose source->target pairs into cycles; returns
+    (cycles, open_paths) where each cycle is a node list."""
+    nxt = dict(pairs)
+    nodes = set(nxt) | {t for _, t in pairs}
+    starts = sorted(nxt)
+    seen: set = set()
+    cycles, open_paths = [], []
+    for s in starts:
+        if s in seen:
+            continue
+        path = [s]
+        seen.add(s)
+        cur = s
+        while True:
+            cur = nxt.get(cur)
+            if cur is None:
+                open_paths.append(path)
+                break
+            if cur == path[0]:
+                cycles.append(path)
+                break
+            if cur in seen:
+                open_paths.append(path)
+                break
+            seen.add(cur)
+            path.append(cur)
+    return cycles, open_paths, nodes
+
+
+def check_permute_cycles(collectives, policy: dict) -> dict:
+    """Every collective-permute must be a valid partial permutation
+    (distinct sources, distinct targets — XLA rejects anything else
+    at compile time, but a hand-edited or cross-version capture must
+    not sneak past the audit), and with `require_single_ring` each
+    permute's pairs must form exactly ONE cycle covering every
+    participant: the ring invariant. A split ring ships identical
+    bytes and still deadlocks the ring reduction."""
+    single = bool(policy.get("require_single_ring"))
+    bad = []
+    n_permutes = 0
+    for c in collectives:
+        if c["kind"] != "collective-permute":
+            continue
+        n_permutes += 1
+        pairs = c["source_target_pairs"]
+        srcs = [s for s, _ in pairs]
+        tgts = [t for _, t in pairs]
+        if len(set(srcs)) != len(srcs) or len(set(tgts)) != len(tgts):
+            bad.append(
+                f"{c['name']}: duplicate source or target in "
+                f"{pairs[:6]}"
+            )
+            continue
+        if single:
+            cycles, open_paths, nodes = _cycles(pairs)
+            if open_paths:
+                bad.append(
+                    f"{c['name']}: {len(open_paths)} open chain(s) — "
+                    f"some rank sends and never receives; the ring "
+                    f"does not close"
+                )
+            elif len(cycles) != 1 or len(cycles[0]) != len(nodes):
+                bad.append(
+                    f"{c['name']}: {len(cycles)} disjoint cycle(s) "
+                    f"over {len(nodes)} ranks — the ring is split"
+                )
+    ok = not bad
+    return {
+        "name": "spmd.schedule.permute_ring",
+        "ok": ok,
+        "permutes": n_permutes,
+        "require_single_ring": single,
+        "detail": (
+            "" if ok else
+            f"{len(bad)} collective-permute(s) break the "
+            f"{'single-ring' if single else 'permutation'} "
+            f"invariant: {bad[:3]}"
+        ),
+    }
+
+
+# ---- driver --------------------------------------------------------
+def spmd_checks(text: str, policy: dict, lines=None):
+    """All SPMD checks for one capture. Returns (checks, summary)
+    where `summary` is the collective byte table for the report."""
+    if lines is None:
+        lines = text.splitlines()
+    collectives = _hlo.parse_collectives(lines)
+    summary = _hlo.collective_summary(collectives)
+    checks = []
+    if "num_partitions" in policy:
+        checks.append(check_partitioning(text, policy))
+    if "replication_floor_bytes" in policy:
+        checks.append(check_replication(lines, policy))
+    checks.extend(check_collective_bytes(summary, policy))
+    checks.append(check_channel_unique(collectives))
+    checks.append(check_channel_order(lines, collectives))
+    checks.append(check_permute_cycles(collectives, policy))
+    return checks, summary

@@ -275,3 +275,40 @@ class TrainStep:
             return compiled(*args)
 
         return run, compiled.as_text()
+
+
+def assert_collectives(hlo: str, where: str, *, require=(),
+                       forbid=()) -> dict:
+    """Parser-backed collective gate (ISSUE 15): parse the compiled
+    module's collective INSTRUCTIONS (analysis/hlo_text) and assert
+    each `require`d kind appears at least once and each `forbid`den
+    kind not at all. Returns {kind: count} so callers can reason
+    about the mix.
+
+    This replaces the old substring gate (`"all-reduce" in hlo`): a
+    substring matches comments, metadata op_names, and region names —
+    e.g. a fused computation NAMED after an inlined-away all-reduce —
+    so it can vacuously pass after the real collective is gone. The
+    parser only counts instruction lines (async -start/-done pairs
+    collapse to one), which is the same object the spmd-audit byte
+    budgets are built from."""
+    from paddle_tpu.analysis import hlo_text as _hlo
+
+    counts: dict = {}
+    for c in _hlo.parse_collectives(hlo.splitlines()):
+        counts[c["kind"]] = counts.get(c["kind"], 0) + 1
+    for kind in require:
+        if not counts.get(kind):
+            raise AssertionError(
+                f"{where}: expected a {kind!r} instruction in the "
+                f"compiled HLO but none parsed (found: {counts}) — "
+                f"a sharding was dropped"
+            )
+    for kind in forbid:
+        if counts.get(kind):
+            raise AssertionError(
+                f"{where}: {counts[kind]} forbidden {kind!r} "
+                f"instruction(s) in the compiled HLO — the program "
+                f"is repartitioning instead of staying sharded"
+            )
+    return counts

@@ -67,4 +67,14 @@ if ! python tools/framework_lint.py hlo-audit; then
   echo "[framework_lint] hlo-audit FAILED"
   rc=1
 fi
+
+# static-analysis gate, tier 3 (ISSUE 15): the SPMD partitioning &
+# collective-schedule audit over the committed mc_* multichip
+# captures — replication floor, collective byte budgets, required/
+# forbidden collective kinds, channel-order/permute-ring deadlock
+# checks, plus the same *.audit.json freshness discipline.
+if ! python tools/framework_lint.py spmd-audit; then
+  echo "[framework_lint] spmd-audit FAILED"
+  rc=1
+fi
 exit $rc

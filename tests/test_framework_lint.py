@@ -57,7 +57,8 @@ class TestDriver:
     def test_list_and_usage(self):
         r = _run(["--list"])
         assert r.returncode == 0
-        for name in ("ast", "bench-static", "obs", "hlo-audit"):
+        for name in ("ast", "bench-static", "obs", "hlo-audit",
+                     "spmd-audit"):
             assert name in r.stdout
         r = _run([])
         assert r.returncode == 2
@@ -152,6 +153,54 @@ class TestAstPasses:
         )
         assert ast_lint.check_unfenced_timing(str(tmp_path)) == []
 
+    def test_raw_collective_outside_shard_map_bites(self, tmp_path):
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "badcoll.py").write_text(
+            "from jax import lax\n"
+            "from paddle_tpu.core.mesh import shard_map\n"
+            "def merge_grads(g):\n"
+            "    return lax.psum(g, 'data')\n"
+            "def ring_root(x):\n"
+            "    return lax.ppermute(x, 'seq', [(0, 1), (1, 0)])\n"
+            "def use(mesh, x):\n"
+            "    return shard_map(ring_root, mesh=mesh,\n"
+            "                     in_specs=(), out_specs=())(x)\n"
+            "def excused(g):\n"
+            "    # lint: raw-collective-ok — pmap-era bridge\n"
+            "    return lax.psum(g, 'batch')\n"
+        )
+        v = ast_lint.check_raw_collective_outside_shard_map(
+            str(tmp_path)
+        )
+        assert len(v) == 1, v
+        assert "merge_grads" in v[0] and "lax.psum" in v[0]
+
+    def test_raw_collective_nesting_and_reference_closure(
+        self, tmp_path
+    ):
+        """The covered region closes over same-file name references
+        (root -> helper) and lexical nesting (fori_loop callbacks) —
+        the shapes ring.py actually uses."""
+        self._scaffold(tmp_path)
+        (tmp_path / "paddle_tpu" / "ringlike.py").write_text(
+            "from jax import lax\n"
+            "from paddle_tpu.core.mesh import shard_map\n"
+            "def _body(axis, x):\n"
+            "    def step(i, c):\n"
+            "        def rotate(kv):\n"
+            "            return lax.ppermute(kv, axis, [(0, 1)])\n"
+            "        return lax.cond(i < 3, rotate, lambda k: k, c)\n"
+            "    return lax.fori_loop(0, 4, step, x)\n"
+            "def attn(mesh, axis, x):\n"
+            "    def local(x):\n"
+            "        return _body(axis, x)\n"
+            "    return shard_map(lambda a: local(a), mesh=mesh,\n"
+            "                     in_specs=(), out_specs=())(x)\n"
+        )
+        assert ast_lint.check_raw_collective_outside_shard_map(
+            str(tmp_path)
+        ) == []
+
     def test_unlocked_mutation_bites_and_pragma(self, tmp_path):
         self._scaffold(tmp_path)
         (tmp_path / "paddle_tpu" / "racy.py").write_text(
@@ -230,13 +279,17 @@ class TestSuiteWiring:
         ).read()
         assert "framework_lint.py --fast" in sh
         assert "framework_lint.py hlo-audit" in sh
+        assert "framework_lint.py spmd-audit" in sh
         assert "PADDLE_LOCK_CHECK=1" in sh
-        # ordering: fast gate before the shard loop, audit after
+        # ordering: fast gate before the shard loop, audits after
         assert sh.index("framework_lint.py --fast") < sh.index(
             "for ((i = 0"
         )
         assert sh.index("framework_lint.py hlo-audit") > sh.index(
             "-m faults"
+        )
+        assert sh.index("framework_lint.py spmd-audit") > sh.index(
+            "framework_lint.py hlo-audit"
         )
 
     def test_committed_audit_reports_exist(self):
@@ -244,8 +297,37 @@ class TestSuiteWiring:
             REPO, "tools", "traces", "audit_budgets.json"
         )))
         stems = [s for s in budgets if not s.startswith("_")]
-        assert len(stems) >= 4
+        # 4 single-device stems (ISSUE 13) + 5 SPMD mc_* stems
+        # (ISSUE 15)
+        assert len(stems) >= 9
         for stem in stems:
             assert os.path.exists(os.path.join(
                 REPO, "tools", "traces", stem + ".audit.json"
             )), f"{stem}.audit.json missing"
+
+    def test_mc_capture_without_audit_report_fails_static(
+        self, tmp_path
+    ):
+        """check_bench_record static mode: a committed mc_* capture
+        with no sibling audit.json is a violation (the cheap
+        existence gate the fast tier runs before the shards)."""
+        import shutil
+
+        import check_bench_record as cbr
+
+        repo2 = tmp_path / "repo"
+        repo2.mkdir()
+        for f in ("bench.py", "bench_multichip.py", "serve_bench.py"):
+            src = os.path.join(REPO, f)
+            if os.path.exists(src):
+                shutil.copy(src, str(repo2 / f))
+        traces = repo2 / "tools" / "traces"
+        traces.mkdir(parents=True)
+        (traces / "mc_orphan.hlo.txt.gz").write_bytes(b"\x1f\x8b")
+        v = [x for x in cbr.check_static(str(repo2))
+             if "mc_orphan" in x]
+        assert len(v) == 1 and "audit.json" in v[0]
+        # adding the report clears it
+        (traces / "mc_orphan.audit.json").write_text("{}")
+        assert not [x for x in cbr.check_static(str(repo2))
+                    if "mc_orphan" in x]

@@ -13,6 +13,33 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# the gate is parser-backed (ISSUE 15): only real collective
+# INSTRUCTION lines count, so the fixtures are HLO instructions, not
+# loose substrings
+_GOOD_HLO = """\
+HloModule gate_fixture, num_partitions=8
+
+ENTRY %main (p0: f32[8,4]) -> f32[8,4] {
+  %p0 = f32[8,4] parameter(0)
+  %ar = f32[8,4] all-reduce(%p0), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, to_apply=%add
+  %cp = f32[8,4] collective-permute(%ar), channel_id=2, source_target_pairs={{0,1},{1,2},{2,3},{3,4},{4,5},{5,6},{6,7},{7,0}}
+  ROOT %a2a = f32[8,4] all-to-all(%cp), channel_id=3, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+}
+"""
+
+# every collective NAME appears — in a comment, an op_name metadata
+# string, and a fusion region name — but no collective INSTRUCTION
+# exists; the old substring gate passed this vacuously
+_DECOY_HLO = """\
+HloModule gate_decoy, num_partitions=8
+
+ENTRY %main (p0: f32[8,4]) -> f32[8,4] {
+  /* the all-reduce and collective-permute were inlined away */
+  ROOT %fused.all-to-all.remat = f32[8,4] add(f32[8,4] %p0, f32[8,4] %p0), metadata={op_name="dp/all-reduce/collective-permute"}
+}
+"""
+
+
 def test_assert_collectives_detects_dropped_sharding():
     sys.path.insert(0, REPO)
     try:
@@ -20,16 +47,54 @@ def test_assert_collectives_detects_dropped_sharding():
     finally:
         sys.path.pop(0)
 
-    good = "fused... all-reduce ... all-to-all ... collective-permute"
-    _assert_collectives(good, "x", all_reduce=True, all_to_all=True,
-                        collective_permute=True)
-    # a replicated program has none of them
+    counts = _assert_collectives(
+        _GOOD_HLO, "x", all_reduce=True, all_to_all=True,
+        collective_permute=True,
+    )
+    assert counts == {
+        "all-reduce": 1, "collective-permute": 1, "all-to-all": 1,
+    }
+    # a replicated program has none of them — and NAME-dropping decoys
+    # (comments/metadata/fusion names) must not satisfy the gate
     with pytest.raises(AssertionError, match="all-reduce"):
-        _assert_collectives("fusion only", "x", all_reduce=True)
+        _assert_collectives(_DECOY_HLO, "x", all_reduce=True)
     with pytest.raises(AssertionError, match="collective-permute"):
         _assert_collectives(
-            "all-reduce", "x", all_reduce=True, collective_permute=True
+            _DECOY_HLO, "x", collective_permute=True
         )
+
+
+def test_assert_collectives_forbid_and_agreement():
+    """Object-level agreement: on a REAL compiled sharded program the
+    parser-backed gate and the compiled module agree kind-by-kind,
+    and `forbid=` bites on a kind that is present."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.core.mesh import DATA_AXIS, make_mesh
+    from paddle_tpu.parallel.dp import assert_collectives
+
+    mesh = make_mesh({DATA_AXIS: jax.device_count()})
+    x = jax.device_put(
+        np.ones((8 * jax.device_count(), 4), np.float32),
+        NamedSharding(mesh, P(DATA_AXIS, None)),
+    )
+    hlo = (
+        jax.jit(lambda v: jnp.sum(v))
+        .lower(x).compile().as_text()
+    )
+    counts = assert_collectives(hlo, "psum", require=["all-reduce"])
+    # agreement with the analysis parser it is built on
+    from paddle_tpu.analysis import hlo_text
+
+    parsed = [
+        c for c in hlo_text.parse_collectives(hlo.splitlines())
+        if c["kind"] == "all-reduce"
+    ]
+    assert counts["all-reduce"] == len(parsed) >= 1
+    with pytest.raises(AssertionError, match="forbidden"):
+        assert_collectives(hlo, "psum", forbid=["all-reduce"])
 
 
 def test_shard_shrink_detects_replicated_param():

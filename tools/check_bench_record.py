@@ -211,6 +211,27 @@ def check_static(repo_dir: str) -> list:
             "— update both together or timeline-field enforcement "
             f"drifts (bench: {north}, lint: {TIMELINE_ROWS})"
         )
+    # every committed multichip capture must carry its audit report
+    # (ISSUE 15): an mc_*.hlo.txt.gz without a sibling *.audit.json is
+    # a sharded program CI never audits — it can replicate or
+    # over-gather without anyone noticing. (Report CONTENT freshness
+    # is the spmd-audit pass's job; this is the cheap jax-free
+    # existence gate that runs before the shards.)
+    traces = os.path.join(repo_dir, "tools", "traces")
+    if os.path.isdir(traces):
+        for f in sorted(os.listdir(traces)):
+            if not (f.startswith("mc_") and f.endswith(".hlo.txt.gz")):
+                continue
+            stem = f[: -len(".hlo.txt.gz")]
+            if not os.path.exists(
+                os.path.join(traces, stem + ".audit.json")
+            ):
+                violations.append(
+                    f"tools/traces/{f}: committed multichip capture "
+                    f"has no {stem}.audit.json — run `python "
+                    f"tools/framework_lint.py spmd-audit "
+                    f"--write-audit` and commit the report"
+                )
     return violations
 
 

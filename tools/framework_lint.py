@@ -25,6 +25,17 @@ Registered passes (run one by name, `--fast`, or `--all`):
                violation. `--write-audit` refreshes them after an
                intentional perf change (then re-baseline
                audit_budgets.json by hand: budgets never auto-widen).
+  spmd-audit   paddle_tpu/analysis/spmd_audit.py over every SPMD-
+               policy capture (the mc_* rows from
+               tools/profile_multichip.py): partition-count pin,
+               replication floor (no tensor above the floor may ride
+               replicated on a sharded program), collective byte
+               budgets + required/forbidden collective kinds, and
+               schedule safety (channel uniqueness, data-dependent
+               channel order, collective-permute ring validity).
+               Same freshness discipline and --write-audit flow as
+               hlo-audit; the two passes split the budgets file by
+               policy kind so `--all` audits every stem exactly once.
 
 Runtime tripwires live next door and are driven elsewhere: the
 recompile guard (analysis/recompile_guard.py) arms inside the trainer
@@ -79,7 +90,14 @@ def pass_obs(repo: str, _args) -> list:
     return [f"[obs] {v}" for v in cbr.check_obs_imports(repo)]
 
 
-def pass_hlo_audit(repo: str, args) -> list:
+def _audit_pass(repo: str, args, tag: str, only=None) -> list:
+    """Shared body of the capture-audit passes: run the auditor over
+    every budgets entry `only` selects, then enforce committed-report
+    freshness — the *.audit.json next to each capture must be exactly
+    what the capture audits to today; a stale report lies about what
+    the lint enforces. `--write-audit` regenerates them after an
+    intentional change (then re-baseline audit_budgets.json by hand:
+    budgets never auto-widen)."""
     from paddle_tpu.analysis import hlo_audit
 
     traces = os.path.join(repo, "tools", "traces")
@@ -88,16 +106,13 @@ def pass_hlo_audit(repo: str, args) -> list:
     budgets = os.path.join(traces, "audit_budgets.json")
     if not os.path.exists(budgets):
         return [
-            f"[hlo-audit] {budgets}: missing — the byte-budget "
+            f"[{tag}] {budgets}: missing — the byte-budget "
             f"baselines are gone; the audit has nothing to enforce"
         ]
-    reports = hlo_audit.audit_dir(traces, budgets)
+    reports = hlo_audit.audit_dir(traces, budgets, only=only)
     violations = [
-        f"[hlo-audit] {v}" for v in hlo_audit.violations(reports)
+        f"[{tag}] {v}" for v in hlo_audit.violations(reports)
     ]
-    # committed report freshness: the *.audit.json next to each
-    # capture must be exactly what the capture audits to today —
-    # stale reports lie about what the lint enforces
     for stem, rep in sorted(reports.items()):
         out_path = os.path.join(traces, stem + ".audit.json")
         if getattr(args, "write_audit", False):
@@ -108,9 +123,9 @@ def pass_hlo_audit(repo: str, args) -> list:
             continue
         if not os.path.exists(out_path):
             violations.append(
-                f"[hlo-audit] {stem}: no committed audit report "
+                f"[{tag}] {stem}: no committed audit report "
                 f"({os.path.basename(out_path)}) — run "
-                f"`python tools/framework_lint.py hlo-audit "
+                f"`python tools/framework_lint.py {tag} "
                 f"--write-audit` and commit it"
             )
             continue
@@ -118,11 +133,32 @@ def pass_hlo_audit(repo: str, args) -> list:
             committed = json.load(f)
         if committed != rep:
             violations.append(
-                f"[hlo-audit] {stem}: committed audit report is "
+                f"[{tag}] {stem}: committed audit report is "
                 f"STALE (capture or auditor changed since it was "
                 f"written) — regenerate with --write-audit"
             )
     return violations
+
+
+def pass_hlo_audit(repo: str, args) -> list:
+    from paddle_tpu.analysis import spmd_audit
+
+    # non-SPMD stems only: the SPMD-policy captures belong to the
+    # spmd-audit pass (one pass per stem, so `--all` audits every
+    # stem exactly once and the two passes can't double-write a
+    # report)
+    return _audit_pass(
+        repo, args, "hlo-audit",
+        only=lambda p: not spmd_audit.is_spmd_policy(p),
+    )
+
+
+def pass_spmd_audit(repo: str, args) -> list:
+    from paddle_tpu.analysis import spmd_audit
+
+    return _audit_pass(
+        repo, args, "spmd-audit", only=spmd_audit.is_spmd_policy
+    )
 
 
 PASSES = {
@@ -130,6 +166,7 @@ PASSES = {
     "bench-static": pass_bench_static,
     "obs": pass_obs,
     "hlo-audit": pass_hlo_audit,
+    "spmd-audit": pass_spmd_audit,
 }
 # the jax-free tier cheap enough to gate every suite run up front
 FAST_PASSES = ("ast", "bench-static", "obs")
