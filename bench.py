@@ -34,6 +34,7 @@ sweep instead (see bench_multichip.py).
 
 import json
 import os
+import shutil
 import sys
 import time
 
@@ -1547,6 +1548,248 @@ def bench_serve_loadtest(vocab=2048, beam=4, max_len=16,
     }
 
 
+def bench_serve_fleet_loadtest(window_s=None):
+    """Fleet-tier robustness row (ISSUE 16): sweep replica count
+    (1/2/3 toy replicas behind a FleetRouter) under sustained
+    closed-loop load, then SIGKILL one replica mid-window at the
+    widest point and measure through the fault: aggregate goodput,
+    p99, and — the headline — `admitted_lost`, which MUST be 0 (a
+    request the router admitted is spilled to a sibling or completed,
+    never dropped; an explicit `overloaded` shed is a refusal, not a
+    loss). The killed replica is then restarted booting from the
+    verified AOT cache and must rejoin rotation through the breaker's
+    half-open probe. `value` = kill-phase goodput (req/s) — the rate
+    the fleet sustains WHILE a replica is dying and rejoining.
+    BENCH_FLEET_SECONDS shrinks the per-point window (CPU smoke)."""
+    import tempfile
+    import threading
+
+    from paddle_tpu import inference
+    from paddle_tpu import testing_faults as tf
+    from paddle_tpu.serving.fleet import FleetConfig, FleetRouter
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    window = (
+        window_s
+        if window_s is not None
+        else float(os.environ.get("BENCH_FLEET_SECONDS", "3"))
+    )
+    n_max = 3
+    n_clients = 8
+
+    # the cache the killed replica will boot from (small program:
+    # this row measures the fleet, the coldstart row measures boot)
+    cache_dir = tempfile.mkdtemp(prefix="fleet-cache-")
+    fn = tf.replica_program_fn(4, 32)
+    inference.store_verified(cache_dir, "fleet",
+                             fn, (np.zeros((1, 8), np.float32),))
+
+    procs = {}
+    addrs = {}
+    for i in range(n_max):
+        p, port = tf.start_serving_replica(
+            repo, REPLICA_MODE="toy", TOY_DELAY_S=0.002,
+            MODEL_TAG="v1", MAX_QUEUE=64)
+        if port is None:
+            raise RuntimeError(f"replica r{i} failed to boot: "
+                               f"{p.boot_line}")
+        procs[f"r{i}"] = p
+        addrs[f"r{i}"] = f"127.0.0.1:{port}"
+
+    def run_point(router, secs, on_half=None):
+        lock = threading.Lock()
+        stop = threading.Event()
+        lat, shed, lost = [], [0], [0]
+
+        def loop():
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                try:
+                    r = router.call("m", [1, 2, 3], deadline_ms=5000,
+                                    trace=False)
+                except Exception:
+                    with lock:
+                        lost[0] += 1
+                    continue
+                if r.get("ok"):
+                    with lock:
+                        lat.append(time.perf_counter() - t0)
+                elif r.get("error") == "overloaded":
+                    with lock:
+                        shed[0] += 1
+                else:
+                    with lock:
+                        lost[0] += 1
+
+        workers = [threading.Thread(target=loop, daemon=True)
+                   for _ in range(n_clients)]
+        t0 = time.perf_counter()
+        for w in workers:
+            w.start()
+        if on_half is not None:
+            time.sleep(secs / 2)
+            on_half()
+            time.sleep(secs / 2)
+        else:
+            time.sleep(secs)
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+        span = time.perf_counter() - t0
+        lat.sort()
+        n = len(lat)
+        return {
+            "completed": n,
+            "goodput_rps": round(n / span, 1),
+            "p50_ms": round(lat[n // 2] * 1e3, 2) if n else None,
+            "p99_ms": round(lat[int(0.99 * (n - 1))] * 1e3, 2)
+            if n else None,
+            "shed": shed[0],
+            "admitted_lost": lost[0],
+        }
+
+    try:
+        fcfg = FleetConfig(poll_interval_s=0.05, breaker_reset_s=0.4)
+        points = []
+        for n in range(1, n_max):
+            sub = {k: addrs[k] for k in list(addrs)[:n]}
+            with FleetRouter(sub, fcfg) as router:
+                time.sleep(0.15)  # first telemetry scrape
+                pt = run_point(router, window)
+                pt["replicas"] = n
+                points.append(pt)
+
+        # widest point: SIGKILL r1 mid-window, keep measuring
+        router = FleetRouter(dict(addrs), fcfg)
+        try:
+            time.sleep(0.15)
+            victim = "r1"
+            rotated = [None]
+
+            def kill_victim():
+                tf.kill_process(procs[victim])
+                deadline = time.monotonic() + fcfg.breaker_reset_s * 4
+                while time.monotonic() < deadline:
+                    if router.states()[victim]["breaker"] != "closed":
+                        rotated[0] = True
+                        return
+                    time.sleep(0.01)
+                rotated[0] = False
+
+            kill = run_point(router, window, on_half=kill_victim)
+            kill["replicas"] = n_max
+            kill["rotated_out"] = rotated[0]
+
+            # restart the victim from the verified cache; it must
+            # rejoin rotation via the half-open probe
+            p, port = tf.start_serving_replica(
+                repo, REPLICA_MODE="cache", CACHE_DIR=cache_dir,
+                CACHE_KEY="fleet", MODEL_TAG="v2")
+            if port is None:
+                raise RuntimeError(f"cache reboot refused: "
+                                   f"{p.boot_line}")
+            procs[victim] = p
+            router.set_address(victim, f"127.0.0.1:{port}")
+            deadline = time.monotonic() + 10
+            rejoined = False
+            while time.monotonic() < deadline:
+                if router.states()[victim]["breaker"] == "closed":
+                    rejoined = True
+                    break
+                time.sleep(0.02)
+            kill["rejoined"] = rejoined
+            kill["rejoin_boot"] = "verified-cache"
+            points.append(kill)
+        finally:
+            router.close()
+    finally:
+        for p in procs.values():
+            tf.kill_process(p)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    total_lost = sum(pt["admitted_lost"] for pt in points)
+    return {
+        "value": kill["goodput_rps"],
+        "unit": "fleet goodput req/s through a replica SIGKILL",
+        "points": points,
+        "kill": {k: kill[k] for k in
+                 ("goodput_rps", "p99_ms", "admitted_lost",
+                  "rotated_out", "rejoined", "rejoin_boot")},
+        "admitted_lost": total_lost,
+        "replica_sweep": [pt["replicas"] for pt in points],
+        "window_s": window,
+        "clients": n_clients,
+    }
+
+
+def bench_serve_coldstart(layers=None, d=256):
+    """Verified-AOT-cache cold-start row (ISSUE 16): boot the same
+    serving replica twice — once compiling its program from scratch,
+    once deserializing it from the digest-pinned, hlo_audit-gated
+    cache — and record both wall times, process start to model ready
+    (interpreter + jax import included in BOTH, so the delta is the
+    compile the cache removes). `value` = compile_boot_s /
+    cache_boot_s. PR11 context: the stock persistent compilation
+    cache deserializes corrupt executables on this runtime, so the
+    fast path only counts because the envelope digest + HLO audit
+    gate runs before anything executes. BENCH_COLDSTART_LAYERS
+    shrinks the program (CPU smoke)."""
+    import tempfile
+
+    from paddle_tpu import inference
+    from paddle_tpu import testing_faults as tf
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    layers = (
+        layers
+        if layers is not None
+        else int(os.environ.get("BENCH_COLDSTART_LAYERS", "48"))
+    )
+    cache_dir = tempfile.mkdtemp(prefix="coldstart-cache-")
+    fn = tf.replica_program_fn(layers, d)
+    t0 = time.perf_counter()
+    inference.store_verified(cache_dir, "cold",
+                             fn, (np.zeros((1, 8), np.float32),))
+    store_s = time.perf_counter() - t0
+
+    def boot(mode, **env):
+        p, port = tf.start_serving_replica(
+            repo, REPLICA_MODE=mode, FN_LAYERS=layers, FN_DIM=d,
+            **env)
+        try:
+            if port is None:
+                raise RuntimeError(f"{mode} boot refused: "
+                                   f"{p.boot_line}")
+            from paddle_tpu.serving.tcp import ServeClient
+            with ServeClient(f"127.0.0.1:{port}") as c:
+                out = c.call("m", [1, 2, 3], deadline_ms=30000,
+                             timeout=60)
+            if not out.get("ok"):
+                raise RuntimeError(f"{mode} boot served junk: {out}")
+            return tf.replica_boot_seconds(p)
+        finally:
+            tf.kill_process(p)
+
+    try:
+        compile_boot_s = boot("compile")
+        cache_boot_s = boot("cache", CACHE_DIR=cache_dir,
+                            CACHE_KEY="cold")
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    return {
+        "value": round(compile_boot_s / cache_boot_s, 2),
+        "unit": "cold-start speedup: compile boot / verified-cache "
+                "boot",
+        "cache_boot_s": round(cache_boot_s, 3),
+        "compile_boot_s": round(compile_boot_s, 3),
+        "store_s": round(store_s, 3),
+        "layers": layers,
+        "d": d,
+        "verified": "sha256 envelope + hlo_audit gate before execute",
+    }
+
+
 def build_sweep():
     # North stars FIRST (VERDICT r4 item 1): the authoritative record
     # must contain the headline rows even if the capture window ends
@@ -1560,6 +1803,8 @@ def build_sweep():
          lambda: bench_nmt(bs=64, t=128, flash_ab=True)),
         ("nmt_beam4_decode_tokens_per_s", bench_beam_decode),
         ("serve_loadtest", bench_serve_loadtest),
+        ("serve_fleet_loadtest", bench_serve_fleet_loadtest),
+        ("serve_coldstart", bench_serve_coldstart),
         ("ctr_sparse_step_v_independence", bench_sparse_ctr),
         ("ctr_widedeep_sparse_v_independence",
          bench_ctr_widedeep_sparse),
@@ -1604,6 +1849,12 @@ def _annotate_baseline(line, name):
         line["baseline"] = (
             "first measured round (r6): serving tracked like "
             "training MFU from here"
+        )
+    elif name in ("serve_fleet_loadtest", "serve_coldstart"):
+        line["vs_baseline"] = 1.0
+        line["baseline"] = (
+            "first measured round (r7): fleet robustness and "
+            "verified-cache cold start tracked from here"
         )
     elif name == "nmt_attention_train_tokens_per_s":
         line["vs_baseline"] = round(line["value"] / R1_NMT_TOK_S, 2)

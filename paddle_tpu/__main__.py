@@ -410,7 +410,11 @@ def cmd_serve(args):
             f"{args.config} must define get_server() -> InferenceServer"
         )
     server = mod.get_server()
-    tcp = ServingTCPServer(server, port=args.port)
+    # optional `load_model(name, tag) -> model` in the config enables
+    # the {"admin": "swap_model"} frame (zero-downtime rollout)
+    tcp = ServingTCPServer(server, port=args.port,
+                           model_loader=getattr(mod, "load_model",
+                                                None))
     print(f"LISTENING {tcp.port}", flush=True)
 
     stopping = []
@@ -425,7 +429,7 @@ def cmd_serve(args):
         # close what remains
         tcp.stop_accepting()
         server.shutdown(drain=True, timeout=args.drain_timeout)
-        tcp.stop()
+        tcp.stop(drain=True)
         print("DRAINED " + _json.dumps(server.stats()), flush=True)
     return 0
 

@@ -38,6 +38,24 @@ AB_ROWS = (
     "nmt_attention_train_tokens_per_s_t128",
 )
 
+# serving-fleet rows bench.py must keep registering (ISSUE 16): the
+# replica-kill sweep and the verified-cache cold-start comparison.
+# Their measured records carry robustness invariants the compare pass
+# enforces field-by-field (FLEET_KILL_FIELDS / COLDSTART_FIELDS below)
+# — in particular `admitted_lost` must be PRESENT and ZERO: a fleet
+# that loses an admitted request during the SIGKILL phase is a
+# correctness regression, not a slow row.
+REQUIRED_SERVE_ROWS = ("serve_fleet_loadtest", "serve_coldstart")
+
+# fields the serve_fleet_loadtest row's `kill` dict must carry —
+# dropping the kill-phase goodput (the whole point of the row) or the
+# loss counter fails the record check
+FLEET_KILL_FIELDS = ("goodput_rps", "admitted_lost")
+
+# fields the serve_coldstart row must carry: both boot times, so the
+# speedup claim stays auditable against its raw measurements
+COLDSTART_FIELDS = ("cache_boot_s", "compile_boot_s")
+
 # north-star rows that must carry the timeline triple (ISSUE 10).
 # MUST equal bench.py's NORTH_STARS — check_bench_record's static
 # mode enforces the sync.

@@ -9,6 +9,8 @@ The pieces:
 - `host_decode` — the per-token host-stepped decode rung (hooks
   without pure_callback).
 - `tcp` — length-prefixed-JSON TCP front end + client.
+- `fleet` — replica router: telemetry-balanced spill-before-shed,
+  breaker-gated rotation, zero-downtime rollout (ISSUE 16).
 
 CLI: `python -m paddle_tpu serve --config serve_conf.py [--port N]`
 where the config defines `get_server() -> InferenceServer`.
@@ -20,4 +22,9 @@ from paddle_tpu.serving.server import (  # noqa: F401
     ServeConfig,
     ServeError,
     ServeRejected,
+)
+from paddle_tpu.serving.fleet import (  # noqa: F401
+    FleetConfig,
+    FleetRouter,
+    ReplicaHandle,
 )

@@ -1,0 +1,344 @@
+"""Fleet tier: a replica router with spill-before-shed, breaker-gated
+rotation, telemetry-driven balancing, and zero-downtime rollout
+(ISSUE 16, ROADMAP item 3 — the PAPER.md master/pserver capability
+reproduced on the inference side).
+
+Topology: `FleetRouter` fronts N independent replica processes, each
+a full `InferenceServer` behind a `ServingTCPServer` socket. The
+router holds, per replica:
+
+- a `_Breaker` (the same class the server uses per model) tracking
+  *transport* health: refused connects, resets, torn frames. A dead
+  replica opens its breaker and is rotated out of candidate order;
+  after `breaker_reset_s` the telemetry poller wins the half-open
+  probe (`try_probe`) and a successful `metricz` scrape closes it —
+  the replica rejoins rotation without any routed request having
+  been gambled on it.
+- a telemetry snapshot (queue depth, shed counts) scraped from the
+  replica's own `metricz` endpoint by a background poller. Routing
+  cost = replica queue depth + requests this router currently has in
+  flight there, so a loaded or wedged replica naturally sinks in the
+  candidate order even before its breaker trips.
+- a client pool (one lazy TCP connection per concurrent caller).
+
+Spill-before-shed: a request is tried on the best candidate first;
+an `overloaded` response or a transport error moves it to the next
+sibling instead of surfacing the shed. Only when every admitting
+replica has refused does the router return `overloaded` — the fleet
+sheds as a last resort, one replica shedding is just a routing hint.
+A transport error mid-call additionally records a breaker failure,
+so a SIGKILLed replica both loses the request to a sibling (zero
+admitted requests lost) and starts accumulating toward rotation.
+
+Rollout (`rollout(model, tag)`): replicas are swapped one at a time.
+The router marks the replica draining (new requests flow to
+siblings — no refused window for a polling client), waits for its
+own in-flight count there to reach zero, sends the
+`{"admin": "swap_model"}` frame (the server's swap is atomic behind
+the admission queue; its queued requests dispatch on the new model),
+then returns the replica to rotation. Zero admitted requests are
+lost at either layer.
+
+Trace propagation: every routed call runs under a `fleet.route` span,
+so the client-side span, the router hop (with the chosen replica and
+spill count as labels), and the replica's `serve.request` tree share
+one trace_id.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from paddle_tpu.obs import metrics as _obs
+from paddle_tpu.obs import tracing as _tracing
+from paddle_tpu.serving.server import _Breaker
+from paddle_tpu.serving.tcp import ServeClient
+
+
+@dataclass
+class FleetConfig:
+    breaker_threshold: int = 3      # consecutive transport failures
+    breaker_reset_s: float = 0.5    # quarantine before half-open
+    poll_interval_s: float = 0.1    # metricz scrape cadence
+    connect_timeout_s: float = 2.0
+    request_timeout_s: float = 30.0
+    scrape_timeout_s: float = 1.0
+    max_spills: int = None          # extra replicas tried; None = all
+    client_retries: int = 2         # per-connect retry (ServeClient)
+
+
+class ReplicaHandle:
+    """Router-side state for one replica. The breaker and the client
+    pool survive `set_address` (a replica restart keeps its history:
+    the new process must pass the half-open probe to rejoin)."""
+
+    def __init__(self, name: str, addr: str, cfg: FleetConfig):
+        self.name = name
+        self.addr = addr
+        self.cfg = cfg
+        self.breaker = _Breaker(cfg.breaker_threshold,
+                                cfg.breaker_reset_s, model=name)
+        self.draining = False
+        self.telemetry: dict = {}
+        self.inflight = 0
+        self._lock = threading.Lock()
+        self._pool: list = []
+
+    def _new_client(self) -> ServeClient:
+        return ServeClient(self.addr,
+                           connect_timeout=self.cfg.connect_timeout_s,
+                           retries=self.cfg.client_retries)
+
+    def checkout(self) -> ServeClient:
+        with self._lock:
+            if self._pool:
+                return self._pool.pop()
+        return self._new_client()
+
+    def checkin(self, client: ServeClient):
+        with self._lock:
+            self._pool.append(client)
+
+    def discard(self, client: ServeClient):
+        try:
+            client.close()
+        except Exception:
+            pass
+
+    def set_address(self, addr: str):
+        """Point at a restarted replica. Pooled connections to the old
+        process are dropped; breaker state is kept so the newcomer
+        goes through probe-back-in rather than instantly absorbing
+        live traffic."""
+        with self._lock:
+            self.addr = addr
+            stale, self._pool = self._pool, []
+        for c in stale:
+            self.discard(c)
+
+    def cost(self) -> float:
+        """Routing cost: the replica's own reported queue depth plus
+        what this router already has in flight there."""
+        depth = 0
+        tel = self.telemetry
+        if isinstance(tel, dict):
+            depth = tel.get("queue_depth", 0) or 0
+        return float(depth) + float(self.inflight)
+
+    def close(self):
+        with self._lock:
+            stale, self._pool = self._pool, []
+        for c in stale:
+            self.discard(c)
+
+
+class FleetRouter:
+    """Route requests across replicas; see the module docstring for
+    the full contract. `replicas` maps name -> "host:port"."""
+
+    def __init__(self, replicas: dict, config: FleetConfig = None):
+        self.config = config or FleetConfig()
+        self._handles = {
+            name: ReplicaHandle(name, addr, self.config)
+            for name, addr in replicas.items()
+        }
+        self._rr = 0
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._poller = threading.Thread(
+            target=self._poll_loop, name="fleet-poll", daemon=True
+        )
+        self._poller.start()
+
+    # ------------------------------------------------------- telemetry
+    def _poll_loop(self):
+        while not self._stopped:
+            for h in list(self._handles.values()):
+                if self._stopped:
+                    return
+                self._scrape(h)
+            time.sleep(self.config.poll_interval_s)
+
+    def _scrape(self, h: ReplicaHandle):
+        """One metricz scrape. Doubles as the half-open liveness
+        probe: for a non-closed breaker the poller must win
+        `try_probe()` first, so rotation-in is decided by a cheap
+        scrape, never by gambling a routed request on a replica that
+        just died."""
+        if h.breaker.state != "closed" and not h.breaker.try_probe():
+            return
+        client = h.checkout()
+        try:
+            resp = client.metricz(timeout=self.config.scrape_timeout_s)
+            stats = resp.get("stats", {}) if isinstance(resp, dict) else {}
+            h.telemetry = stats
+            was_open = h.breaker.state != "closed"
+            h.breaker.record(True)
+            if was_open:
+                _obs.get_registry().counter(
+                    "fleet.rejoins").inc(replica=h.name)
+            h.checkin(client)
+        except Exception:
+            h.discard(client)
+            h.breaker.record(False)
+
+    # --------------------------------------------------------- routing
+    def _candidates(self) -> list:
+        """Admitting, non-draining replicas, cheapest first; round-
+        robin rotation breaks ties so equal-cost replicas share load
+        instead of the dict-order replica taking everything."""
+        with self._lock:
+            self._rr += 1
+            rr = self._rr
+        handles = list(self._handles.values())
+        n = len(handles)
+        rotated = handles[rr % n:] + handles[: rr % n] if n else []
+        live = [h for h in rotated
+                if not h.draining and h.breaker.admits()]
+        return sorted(live, key=lambda h: h.cost())
+
+    def call(self, model: str, ids, deadline_ms: int = None,
+             hooks: str = None, timeout: float = None,
+             trace=None) -> dict:
+        """Route one request. Returns the replica's response dict; a
+        fleet-level shed ({"ok": False, "error": "overloaded"}) only
+        after every admitting replica refused or failed."""
+        with _tracing.span("fleet.route", model=model) as sp:
+            resp = self._route(model, ids, deadline_ms, hooks,
+                               timeout, trace, sp)
+            if isinstance(resp, dict) and not resp.get("ok", False):
+                sp.status = resp.get("error", "error")
+            return resp
+
+    def _route(self, model, ids, deadline_ms, hooks, timeout,
+               trace, sp) -> dict:
+        reg = _obs.get_registry()
+        cands = self._candidates()
+        limit = len(cands) if self.config.max_spills is None \
+            else min(len(cands), self.config.max_spills + 1)
+        last_shed = None
+        spills = 0
+        for h in cands[:limit]:
+            # half-open: only one probe request at a time may test a
+            # recovering replica; everyone else spills past it
+            if h.breaker.state != "closed" and not h.breaker.try_probe():
+                continue
+            with h._lock:
+                h.inflight += 1
+            client = h.checkout()
+            try:
+                resp = client.call(
+                    model, ids, deadline_ms=deadline_ms, hooks=hooks,
+                    timeout=timeout or self.config.request_timeout_s,
+                    trace=trace,
+                )
+            except Exception:
+                # transport death (SIGKILL, reset, torn frame): the
+                # request was NOT acknowledged — retry it on a sibling
+                # and charge the breaker
+                h.discard(client)
+                h.breaker.record(False)
+                reg.counter("fleet.transport_errors").inc(
+                    replica=h.name)
+                spills += 1
+                continue
+            finally:
+                with h._lock:
+                    h.inflight -= 1
+            h.checkin(client)
+            if isinstance(resp, dict) and not resp.get("ok", False) \
+                    and resp.get("error") in ("overloaded",
+                                              "shutting_down"):
+                # replica-level shed = fleet-level routing hint
+                h.breaker.record(True)  # alive, just busy
+                reg.counter("fleet.spills").inc(replica=h.name)
+                last_shed = resp
+                spills += 1
+                continue
+            h.breaker.record(True)
+            reg.counter("fleet.routed").inc(replica=h.name)
+            sp.labels["replica"] = h.name
+            sp.labels["spills"] = spills
+            return resp
+        reg.counter("fleet.shed").inc()
+        if last_shed is not None:
+            return dict(last_shed, fleet_spills=spills)
+        return {"ok": False, "error": "overloaded",
+                "detail": "no admitting replica", "fleet_spills": spills}
+
+    # --------------------------------------------------------- rollout
+    def rollout(self, model: str, tag: str = None,
+                drain_timeout_s: float = 10.0) -> dict:
+        """Zero-downtime hot swap of `model` across the fleet, one
+        replica at a time. Returns {replica: swap-response}. Raises
+        RuntimeError if any replica's swap fails — the fleet is then
+        mixed-version and the caller must retry or roll back."""
+        results = {}
+        for h in list(self._handles.values()):
+            h.draining = True  # siblings absorb; no refused window
+            try:
+                deadline = time.monotonic() + drain_timeout_s
+                while time.monotonic() < deadline:
+                    with h._lock:
+                        if h.inflight == 0:
+                            break
+                    time.sleep(0.005)
+                client = h.checkout()
+                try:
+                    msg = {"admin": "swap_model", "model": model}
+                    if tag is not None:
+                        msg["tag"] = tag
+                    resp = client._roundtrip(
+                        msg, timeout=self.config.request_timeout_s)
+                except Exception as e:
+                    h.discard(client)
+                    raise RuntimeError(
+                        f"rollout: swap on {h.name} died: {e}") from e
+                h.checkin(client)
+                results[h.name] = resp
+                if not (isinstance(resp, dict) and resp.get("ok")):
+                    raise RuntimeError(
+                        f"rollout: swap on {h.name} refused: {resp}")
+                _obs.get_registry().counter("fleet.rollouts").inc(
+                    replica=h.name, model=model)
+            finally:
+                h.draining = False
+        return results
+
+    # ----------------------------------------------------- maintenance
+    def set_address(self, name: str, addr: str):
+        """Re-point a replica after a restart (keeps breaker state —
+        the new process rejoins via the half-open probe)."""
+        self._handles[name].set_address(addr)
+
+    def handle(self, name: str) -> ReplicaHandle:
+        return self._handles[name]
+
+    def states(self) -> dict:
+        """Per-replica router view (breaker state, cost, draining) —
+        the fleet-level `metricz` complement."""
+        return {
+            name: {
+                "addr": h.addr,
+                "breaker": h.breaker.state,
+                "draining": h.draining,
+                "inflight": h.inflight,
+                "queue_depth": (h.telemetry or {}).get("queue_depth"),
+                "cost": h.cost(),
+            }
+            for name, h in self._handles.items()
+        }
+
+    def close(self):
+        self._stopped = True
+        self._poller.join(2.0)
+        for h in self._handles.values():
+            h.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -157,7 +157,14 @@ class _Breaker:
     """Per-model circuit breaker: closed -> open after N consecutive
     failures -> half-open probe after reset_s -> closed on success.
     State transitions are counted in the process registry
-    (`serving.breaker_opens{model=}` / `serving.dispatch_failures`)."""
+    (`serving.breaker_opens{model=}` / `serving.dispatch_failures`).
+
+    Thread-safe on its own lock (ISSUE 16): InferenceServer always
+    called it under the admission lock, but the fleet router shares
+    the class across its routing threads with no outer lock — two
+    threads racing `try_probe()` in half-open must admit exactly one
+    probe. The internal lock is a leaf (ordered strictly after
+    `serving.admission` wherever both are held)."""
 
     def __init__(self, threshold: int, reset_s: float,
                  model: str = ""):
@@ -171,6 +178,7 @@ class _Breaker:
         # and clears it OUTSIDE the server lock to fire the flight-
         # recorder dump (file I/O must not run under the hot lock)
         self.just_opened = False
+        self._lock = named_lock("serving.breaker")
 
     @property
     def state(self) -> str:
@@ -184,32 +192,41 @@ class _Breaker:
         return self.state != "open"
 
     def try_probe(self) -> bool:
-        """In half-open, exactly one in-flight probe batch at a time."""
-        if self.state == "closed":
-            return True
-        if self.state == "half-open" and not self.probing:
-            self.probing = True
-            return True
-        return False
+        """In half-open, exactly one in-flight probe batch at a time —
+        the probing flag is checked-and-set under the breaker lock, so
+        concurrent callers cannot both win."""
+        with self._lock:
+            st = self.state
+            if st == "closed":
+                return True
+            if st == "half-open" and not self.probing:
+                self.probing = True
+                return True
+            return False
 
     def record(self, ok: bool):
-        self.probing = False
-        if ok:
-            self.failures = 0
-            self.opened_at = None
-        else:
-            self.failures += 1
-            _obs.get_registry().counter(
-                "serving.dispatch_failures"
-            ).inc(model=self.model)
-            if self.failures >= self.threshold:
-                was_open = self.opened_at is not None
-                self.opened_at = time.monotonic()
-                if not was_open:
-                    self.just_opened = True
-                    _obs.get_registry().counter(
-                        "serving.breaker_opens"
-                    ).inc(model=self.model)
+        """A failed record while open/half-open re-opens the breaker
+        with the backoff window reset (opened_at moves to now): a
+        failed probe buys a full fresh quarantine, not a shortened
+        one."""
+        with self._lock:
+            self.probing = False
+            if ok:
+                self.failures = 0
+                self.opened_at = None
+            else:
+                self.failures += 1
+                _obs.get_registry().counter(
+                    "serving.dispatch_failures"
+                ).inc(model=self.model)
+                if self.failures >= self.threshold:
+                    was_open = self.opened_at is not None
+                    self.opened_at = time.monotonic()
+                    if not was_open:
+                        self.just_opened = True
+                        _obs.get_registry().counter(
+                            "serving.breaker_opens"
+                        ).inc(model=self.model)
 
 
 @dataclass
@@ -340,6 +357,28 @@ class InferenceServer:
                                  self.config.breaker_reset_s,
                                  model=name),
             )
+
+    def swap_model(self, name: str, model) -> None:
+        """Atomic hot-swap (ISSUE 16 rollout): replace `name`'s model
+        behind the admission queue. Requests already queued dispatch
+        on the NEW model (batch formation resolves the entry at pop
+        time); batches already in flight complete on the old one —
+        either way every admitted request reaches a terminal state,
+        so a rollout loses nothing. The fresh entry also resets the
+        breaker and the EWMA service time: they described the old
+        program."""
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(f"unknown model {name!r}")
+            self._models[name] = _ModelEntry(
+                model=model,
+                breaker=_Breaker(self.config.breaker_threshold,
+                                 self.config.breaker_reset_s,
+                                 model=name),
+            )
+        _obs.get_registry().counter("serving.model_swaps").inc(
+            model=name
+        )
 
     def submit(self, model: str, ids, deadline_s: float = None,
                hooks=None, hooks_name: str = None,

@@ -7,6 +7,7 @@ Frame = 4-byte LE length + UTF-8 JSON. Request:
      "trace": {"trace_id": str, "span_id": str}?}   # trace carrier
   | {"metricz": true}          # telemetry scrape (no inference)
   | {"tracez": true, "top": int?}   # slow-request exemplars
+  | {"admin": "swap_model", "model": str, "tag": str?}  # hot-swap
 
 Response:
 
@@ -45,9 +46,11 @@ being served.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import struct
 import threading
+import time
 
 from paddle_tpu.obs import metrics as _obs
 from paddle_tpu.obs import tracing as _tracing
@@ -90,13 +93,24 @@ def recv_msg(sock: socket.socket):
 
 class ServingTCPServer:
     """Accept loop + one handler thread per connection, all daemonic.
-    `stop()` closes the listener and the open connections; the
-    underlying InferenceServer is NOT shut down here (the CLI owns its
-    drain) so in-flight dispatches complete."""
+    `stop()` closes the listener and the open connections —
+    `stop(drain=True)` first waits (bounded) for in-flight requests
+    to finish and their responses to flush, then joins the handler
+    threads, so "zero admitted requests lost" is a guarantee rather
+    than a timing accident (ISSUE 16). The underlying InferenceServer
+    is NOT shut down here (the CLI owns its drain) so in-flight
+    dispatches complete.
+
+    `model_loader` (optional): callable `(model_name, tag) -> model`
+    backing the `{"admin": "swap_model"}` frame — the zero-downtime
+    rollout hook. The loader runs on the admin connection's handler
+    thread while every other connection keeps being served; the swap
+    itself is atomic inside InferenceServer.swap_model."""
 
     def __init__(self, server: InferenceServer, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, model_loader=None):
         self.server = server
+        self.model_loader = model_loader
         self._listener = socket.socket()
         self._listener.setsockopt(socket.SOL_SOCKET,
                                   socket.SO_REUSEADDR, 1)
@@ -105,6 +119,8 @@ class ServingTCPServer:
         self.port = self._listener.getsockname()[1]
         self._stopped = False
         self._conns: list = []
+        self._handlers: list = []
+        self._inflight = 0
         self._lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._accept_loop, name="serve-tcp", daemon=True
@@ -119,9 +135,24 @@ class ServingTCPServer:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._lock:
+                if self._stopped:
+                    # raced stop_accepting() between accept() and
+                    # registration: this connection would outlive
+                    # stop()'s sweep of self._conns — close it here
+                    # instead of serving it
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    return
                 self._conns.append(conn)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True).start()
+                t = threading.Thread(target=self._serve_conn,
+                                     args=(conn,), daemon=True)
+                self._handlers.append(t)
+                self._handlers = [
+                    h for h in self._handlers if h.is_alive() or h is t
+                ]
+            t.start()
 
     def _serve_conn(self, conn: socket.socket):
         try:
@@ -132,12 +163,20 @@ class ServingTCPServer:
                     return  # torn/garbage client: drop the connection
                 if msg is None:
                     return
-                resp = self._handle(msg)
+                # in-flight accounting covers handle AND the response
+                # send: drain counts a request until its bytes left
+                with self._lock:
+                    self._inflight += 1
                 try:
-                    send_msg(conn, resp)
-                except OSError:
-                    return  # client gone mid-response: request already
-                    # terminal server-side, nothing leaks
+                    resp = self._handle(msg)
+                    try:
+                        send_msg(conn, resp)
+                    except OSError:
+                        return  # client gone mid-response: request
+                        # already terminal server-side, nothing leaks
+                finally:
+                    with self._lock:
+                        self._inflight -= 1
         finally:
             with self._lock:
                 if conn in self._conns:
@@ -167,6 +206,29 @@ class ServingTCPServer:
                 "ok": True,
                 "tracez": self.server.slow_exemplars(top=top),
             }
+        if isinstance(msg, dict) and msg.get("admin") == "swap_model":
+            # zero-downtime hot swap: runs on this connection's handler
+            # thread while every other connection keeps serving. The
+            # actual switch is atomic inside InferenceServer.swap_model
+            # (under the admission lock), so queued requests dispatch
+            # against the new model and nothing is lost.
+            name = msg.get("model")
+            if not isinstance(name, str):
+                return {"ok": False, "error": "bad_request",
+                        "detail": "admin swap_model needs a model name"}
+            if self.model_loader is None:
+                return {"ok": False, "error": "no_loader",
+                        "detail": "server started without a model_loader"}
+            try:
+                new_model = self.model_loader(name, msg.get("tag"))
+                self.server.swap_model(name, new_model)
+            except KeyError:
+                return {"ok": False, "error": "unknown_model"}
+            except Exception as e:
+                return {"ok": False, "error": "swap_failed",
+                        "detail": f"{type(e).__name__}: {e}"}
+            return {"ok": True, "swapped": name,
+                    "tag": msg.get("tag")}
         try:
             model = msg["model"]
             ids = msg["ids"]
@@ -207,46 +269,103 @@ class ServingTCPServer:
         resp.update(out)
         return resp
 
-    def stop_accepting(self):
+    def stop_accepting(self, timeout: float = 1.0):
         """Close the listener only — established connections keep
-        being served. The drain sequence is stop_accepting() ->
-        InferenceServer.shutdown(drain=True) -> stop(), so clients
-        with in-flight requests receive their drained responses
-        instead of a reset."""
-        self._stopped = True
+        being served. Sets `_stopped` under the connection lock BEFORE
+        closing the listener, so an accept() that races this call
+        cannot register a new connection after `stop()` has swept
+        `self._conns`; the accept thread is then joined (bounded) so
+        no accept-loop activity overlaps the rest of the drain. The
+        drain sequence is stop_accepting() ->
+        InferenceServer.shutdown(drain=True) -> stop(drain=True), so
+        clients with in-flight requests receive their drained
+        responses instead of a reset. Idempotent."""
+        with self._lock:
+            self._stopped = True
+        try:
+            # shutdown() wakes a thread blocked in accept() (a bare
+            # close() does not, on Linux); then release the fd
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
             pass
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout)
 
-    def stop(self):
-        self.stop_accepting()
+    def stop(self, drain: bool = False, timeout: float = 5.0):
+        """Tear down the front end. With `drain=True`, wait (up to
+        `timeout` seconds) for in-flight requests — admitted frames
+        whose response has not yet been sent — to reach zero before
+        closing connections, then join handler threads with the
+        remaining deadline. Idle keep-alive connections do not count
+        as in-flight, so drain cannot be stalled by a client that is
+        merely connected."""
+        deadline = time.monotonic() + timeout
+        self.stop_accepting(timeout=min(1.0, timeout))
+        if drain:
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self._inflight == 0:
+                        break
+                time.sleep(0.005)
         with self._lock:
             conns, self._conns = self._conns, []
+            handlers, self._handlers = self._handlers, []
         for c in conns:
             try:
                 c.close()
             except OSError:
                 pass
+        if drain:
+            for h in handlers:
+                h.join(max(0.0, deadline - time.monotonic()))
 
 
 class ServeClient:
     """Blocking single-connection client (tests + load generator).
-    Reconnects lazily after a connection error."""
+    Reconnects lazily after a connection error.
 
-    def __init__(self, addr: str, connect_timeout: float = 5.0):
+    `_connect` retries refused/reset connects with jittered
+    exponential backoff (`retries` attempts beyond the first,
+    doubling from `backoff_s` capped at `backoff_max_s`): the fleet
+    router rides over a replica restart instead of failing the first
+    request after a respawn. `retries=0` preserves fail-fast
+    behavior for tests that assert a dead address errors
+    immediately."""
+
+    def __init__(self, addr: str, connect_timeout: float = 5.0,
+                 retries: int = 3, backoff_s: float = 0.05,
+                 backoff_max_s: float = 1.0):
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
         self._port = int(port)
         self._timeout = connect_timeout
+        self._retries = max(0, int(retries))
+        self._backoff_s = backoff_s
+        self._backoff_max_s = backoff_max_s
         self._sock = None
 
     def _connect(self):
-        self._sock = socket.create_connection(
-            (self._host, self._port), timeout=self._timeout
-        )
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.settimeout(None)
+        delay = self._backoff_s
+        for attempt in range(self._retries + 1):
+            try:
+                sock = socket.create_connection(
+                    (self._host, self._port), timeout=self._timeout
+                )
+                break
+            except (ConnectionRefusedError, ConnectionResetError):
+                if attempt == self._retries:
+                    raise
+                # full jitter on the low half so a fleet of clients
+                # reconnecting to a restarted replica doesn't stampede
+                time.sleep(delay * (0.5 + random.random() * 0.5))
+                delay = min(delay * 2, self._backoff_max_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.settimeout(None)
+        self._sock = sock
 
     def call(self, model: str, ids, deadline_ms: int = None,
              hooks: str = None, timeout: float = None,

@@ -244,6 +244,180 @@ def start_preemptible_trainer(repo: str, save_dir: str, out_file: str,
     )
 
 
+def replica_program_fn(layers: int = 16, d: int = 256):
+    """The canonical serving program for fleet/coldstart harnesses: a
+    `layers`-deep tanh MLP over a [B, 8] f32 feed. Both the cache
+    *store* side (tests / bench compile it once through
+    `inference.store_verified`) and the replica's compile-from-scratch
+    boot mode build it from here, so the verified-cache row compares
+    the same program, not two different ones."""
+    import jax.numpy as jnp
+
+    def fn(x):
+        h = x
+        for i in range(layers):
+            w = jnp.full((h.shape[-1], d), 0.01, jnp.float32)
+            h = jnp.tanh(h @ w + i * 1e-3)
+        return jnp.sum(h, axis=-1)
+
+    return fn
+
+
+SERVING_REPLICA_SRC = """
+import json, os, sys, threading, time
+t0 = time.monotonic()
+sys.path.insert(0, os.environ["REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from paddle_tpu.serving.server import InferenceServer, ServeConfig
+from paddle_tpu.serving.tcp import ServingTCPServer
+
+mode = os.environ.get("REPLICA_MODE", "toy")   # toy | cache | compile
+model_name = os.environ.get("MODEL_NAME", "m")
+tag = os.environ.get("MODEL_TAG", "v1")
+delay = float(os.environ.get("TOY_DELAY_S", "0.005"))
+max_queue = int(os.environ.get("MAX_QUEUE", "64"))
+max_batch = int(os.environ.get("MAX_BATCH", "4"))
+deadline = float(os.environ.get("DEADLINE_S", "30"))
+
+
+class Toy:
+    can_host = False
+    engine = None
+    named_hooks = {}
+    def __init__(self, tag, delay_s):
+        self.tag = tag
+        self.delay_s = delay_s
+    def run_batch(self, ids, lens, hooks, host):
+        time.sleep(self.delay_s)
+        return [{"tokens": [int(lens[i])], "score": 0.0,
+                 "tag": self.tag} for i in range(ids.shape[0])]
+
+
+class Cached:
+    # AOT executables are shape-specialized, so cache/compile replicas
+    # run with max_batch=1 + a single length bucket: every dispatch is
+    # exactly the [1, 8] feed the program was compiled for
+    can_host = False
+    engine = None
+    named_hooks = {}
+    def __init__(self, prog, tag):
+        self.prog = prog
+        self.tag = tag
+    def run_batch(self, ids, lens, hooks, host):
+        y = np.asarray(self.prog(ids.astype(np.float32)))
+        return [{"tokens": [int(lens[i])],
+                 "score": float(np.ravel(y)[i]), "tag": self.tag}
+                for i in range(ids.shape[0])]
+
+
+def _boot_model(new_tag):
+    if mode == "toy":
+        return Toy(new_tag, delay)
+    from paddle_tpu import inference, testing_faults
+    if mode == "cache":
+        policy = json.loads(os.environ.get("CACHE_POLICY", "null"))
+        prog = inference.load_verified(
+            os.environ["CACHE_DIR"], os.environ["CACHE_KEY"],
+            policy=policy)
+        return Cached(prog, new_tag)
+    fn = testing_faults.replica_program_fn(
+        int(os.environ.get("FN_LAYERS", "16")),
+        int(os.environ.get("FN_DIM", "256")))
+    compiled = jax.jit(fn).lower(
+        np.zeros((1, 8), np.float32)).compile()
+    return Cached(compiled, new_tag)
+
+
+try:
+    model = _boot_model(tag)
+except BaseException as e:
+    # the verified-cache gate biting IS a supported outcome: refuse
+    # loudly, exit nonzero, serve nothing
+    print("BOOT_REFUSED " + type(e).__name__ + ": " + str(e),
+          flush=True)
+    sys.exit(3)
+print("BOOT %s %.6f" % (mode, time.monotonic() - t0), flush=True)
+
+srv = InferenceServer(ServeConfig(
+    max_queue=max_queue,
+    max_batch=1 if mode != "toy" else max_batch,
+    default_deadline_s=deadline,
+    buckets=(8,) if mode != "toy" else (8, 16, 32, 64),
+))
+srv.add_model(model_name, model)
+
+
+def load_model(name, new_tag):
+    return _boot_model(new_tag or "swapped")
+
+
+tcp = ServingTCPServer(srv, port=int(os.environ.get("PORT", "0")),
+                       model_loader=load_model)
+print("LISTENING %d" % tcp.port, flush=True)
+
+done = threading.Event()
+import signal
+signal.signal(signal.SIGTERM, lambda *a: done.set())
+done.wait()
+tcp.stop_accepting()
+srv.shutdown(drain=True)
+tcp.stop(drain=True)
+print("DRAINED", flush=True)
+"""
+
+
+def start_serving_replica(repo: str, **env_overrides):
+    """Launch one serving replica (SERVING_REPLICA_SRC) and wait for
+    its boot handshake. Returns `(proc, port)`; `port` is None when
+    the boot was refused (verified-cache gate) or the process died
+    before listening. The boot line ("BOOT <mode> <seconds>" or
+    "BOOT_REFUSED <err>") is stashed on `proc.boot_line`.
+
+    Knobs via env_overrides: REPLICA_MODE (toy|cache|compile),
+    MODEL_NAME, MODEL_TAG, TOY_DELAY_S, MAX_QUEUE, MAX_BATCH,
+    DEADLINE_S, CACHE_DIR, CACHE_KEY, CACHE_POLICY (JSON), FN_LAYERS,
+    FN_DIM, PORT."""
+    env = dict(
+        os.environ, REPO=repo, JAX_PLATFORMS="cpu",
+        **{k: str(v) for k, v in env_overrides.items()},
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", SERVING_REPLICA_SRC], env=env,
+        cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    boot = None
+    port = None
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            break
+        line = line.strip()
+        if line.startswith("BOOT_REFUSED"):
+            boot = line
+            break
+        if line.startswith("BOOT "):
+            boot = line
+            continue
+        if line.startswith("LISTENING"):
+            port = int(line.split()[1])
+            break
+    proc.boot_line = boot
+    return proc, port
+
+
+def replica_boot_seconds(proc) -> float:
+    """Parse the boot duration off a replica's handshake line."""
+    line = getattr(proc, "boot_line", None) or ""
+    parts = line.split()
+    if len(parts) == 3 and parts[0] == "BOOT":
+        return float(parts[2])
+    raise ValueError(f"no boot line on replica: {line!r}")
+
+
 class FlakyProxy:
     """TCP proxy with programmable connection faults.
 

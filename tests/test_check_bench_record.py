@@ -253,3 +253,83 @@ def test_static_pins_mc_longctx_rows(tmp_path):
     (work / "bench_multichip.py").write_text(src)
     v = cbr.check_static(str(work))
     assert any("mc_longctx_ulysses_t32768" in x for x in v)
+
+
+def test_compare_fleet_row_schema(tmp_path):
+    """ISSUE 16: the serve_fleet_loadtest row must carry its kill
+    phase (goodput through the SIGKILL + admitted_lost) and report
+    zero admitted loss at both row and kill scope — dropping the
+    field or reporting a loss fails the record check."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    good = {
+        "metric": "serve_fleet_loadtest", "value": 100.0,
+        "admitted_lost": 0,
+        "kill": {"goodput_rps": 100.0, "p99_ms": 8.0,
+                 "admitted_lost": 0},
+    }
+    assert lint(good) == []
+    # kill dict missing entirely
+    v = lint({"metric": "serve_fleet_loadtest", "value": 1.0,
+              "admitted_lost": 0})
+    assert v and "kill" in v[0]
+    # kill-phase goodput dropped
+    v = lint(dict(good, kill={"admitted_lost": 0}))
+    assert any("goodput_rps" in x for x in v)
+    # nonzero loss at row scope
+    v = lint(dict(good, admitted_lost=3))
+    assert any("admitted_lost=3" in x for x in v)
+    # nonzero loss inside the kill phase
+    v = lint(dict(good, kill={"goodput_rps": 9.0, "admitted_lost": 1}))
+    assert any("admitted_lost=1" in x for x in v)
+    # loss counter silently omitted from the row
+    v = lint({"metric": "serve_fleet_loadtest", "value": 1.0,
+              "kill": {"goodput_rps": 9.0, "admitted_lost": 0}})
+    assert any("'admitted_lost'" in x for x in v)
+    # errored rows stay exempt
+    assert lint({"metric": "serve_fleet_loadtest", "value": None,
+                 "error": "x"}) == []
+
+
+def test_compare_coldstart_row_schema(tmp_path):
+    """The serve_coldstart speedup must stay auditable: both raw boot
+    times recorded, or the record check fails."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    good = {"metric": "serve_coldstart", "value": 3.0,
+            "cache_boot_s": 0.5, "compile_boot_s": 1.5}
+    assert lint(good) == []
+    bad = {"metric": "serve_coldstart", "value": 3.0,
+           "cache_boot_s": 0.5}
+    v = lint(bad)
+    assert v and "compile_boot_s" in v[0]
+    assert lint({"metric": "serve_coldstart", "skipped": "budget"}) \
+        == []
+
+
+def test_static_pins_fleet_rows(tmp_path):
+    """Deleting serve_fleet_loadtest/serve_coldstart from bench.py's
+    sweep is a robustness-record regression the static lint catches."""
+    import shutil
+
+    work = tmp_path / "repo"
+    work.mkdir()
+    shutil.copy(os.path.join(REPO, "bench_multichip.py"),
+                work / "bench_multichip.py")
+    src = open(os.path.join(REPO, "bench.py")).read()
+    src = src.replace("serve_fleet_loadtest", "fleet_row_gone")
+    (work / "bench.py").write_text(src)
+    v = cbr.check_static(str(work))
+    assert any("serve_fleet_loadtest" in x for x in v)

@@ -44,6 +44,12 @@ both run by `tests/test_check_bench_record.py`:
   `span_device_frac`) AND it must agree with the registry-derived
   triple the row already carries, within SPAN_SPLIT_TOL — two
   independent measurement paths cross-checking each other.
+- **fleet rows** (ISSUE 16): static mode pins the permanent
+  `serve_fleet_loadtest` / `serve_coldstart` rows in bench.py;
+  compare mode requires the fleet row's kill-phase dict (goodput
+  through the SIGKILL + `admitted_lost`, which must be 0 at both row
+  and kill scope) and the coldstart row's raw
+  `cache_boot_s`/`compile_boot_s` pair.
 - **bundle schema** (`bundle` subcommand): static lint of
   flight-recorder bundles (obs/flight_recorder.py) — schema tag,
   required top-level fields, well-formed span events.
@@ -82,7 +88,10 @@ if _REPO not in sys.path:
 # blocked, like this whole tool.
 from paddle_tpu.analysis.rows import (  # noqa: E402
     AB_ROWS,
+    COLDSTART_FIELDS,
+    FLEET_KILL_FIELDS,
     REQUIRED_MC_ROWS,
+    REQUIRED_SERVE_ROWS,
     TIMELINE_FIELDS,
     TIMELINE_ROWS,
     needs_timeline,
@@ -177,6 +186,18 @@ def check_static(repo_dir: str) -> list:
             violations.append(
                 f"bench_multichip.py: permanent row {row!r} is no "
                 f"longer registered — the elasticity record would "
+                f"silently stop being captured"
+            )
+    # the serving-fleet rows (ISSUE 16) are permanent the same way:
+    # the kill sweep and the verified-cache cold start must stay in
+    # bench.py's sweep
+    with open(os.path.join(repo_dir, "bench.py")) as f:
+        bench_src = f.read()
+    for row in REQUIRED_SERVE_ROWS:
+        if row not in bench_src:
+            violations.append(
+                f"bench.py: permanent row {row!r} is no longer "
+                f"registered — the fleet robustness record would "
                 f"silently stop being captured"
             )
     # TIMELINE_ROWS here must equal bench.py's NORTH_STARS, else the
@@ -338,6 +359,12 @@ def check_compare(stdout_path: str, record_path: str) -> list:
         if m == "serve_loadtest" and "error" not in d \
                 and "skipped" not in d:
             violations.extend(_check_serve_span_split(d))
+        if m == "serve_fleet_loadtest" and "error" not in d \
+                and "skipped" not in d:
+            violations.extend(_check_fleet_row(d))
+        if m == "serve_coldstart" and "error" not in d \
+                and "skipped" not in d:
+            violations.extend(_check_coldstart_row(d))
         # A/B tripwire (ISSUE 12): a measured longctx/NMT-T128 row
         # without a flash A/B verdict means the dense-vs-flash
         # comparison silently dropped out of the record
@@ -386,6 +413,57 @@ def _check_serve_span_split(row: dict) -> list:
             f"tol={SPAN_SPLIT_TOL}"
         )
     return violations
+
+
+def _check_fleet_row(row: dict) -> list:
+    """serve_fleet_loadtest rows (ISSUE 16): the kill-phase dict must
+    carry its goodput and loss fields, and `admitted_lost` — both the
+    row total and the kill phase — must be exactly 0. A fleet that
+    loses an admitted request while one replica is SIGKILLed is a
+    robustness regression; dropping the kill-phase goodput field is
+    the same regression hidden by omission."""
+    violations = []
+    kill = row.get("kill")
+    if not isinstance(kill, dict):
+        return [
+            "row 'serve_fleet_loadtest': missing 'kill' dict — the "
+            "SIGKILL-mid-sweep phase is the point of the row and must "
+            "be recorded"
+        ]
+    missing = [f for f in FLEET_KILL_FIELDS if f not in kill]
+    if missing:
+        violations.append(
+            f"row 'serve_fleet_loadtest': kill phase missing "
+            f"field(s) {missing} — goodput-through-the-fault and the "
+            f"loss counter must both be recorded"
+        )
+    for scope, holder in (("row", row), ("kill phase", kill)):
+        lost = holder.get("admitted_lost")
+        if lost is not None and lost != 0:
+            violations.append(
+                f"row 'serve_fleet_loadtest': {scope} reports "
+                f"admitted_lost={lost} — an admitted request must be "
+                f"spilled or completed, never lost (0 required)"
+            )
+    if "admitted_lost" not in row:
+        violations.append(
+            "row 'serve_fleet_loadtest': missing 'admitted_lost' — "
+            "the zero-loss invariant must be recorded, not implied"
+        )
+    return violations
+
+
+def _check_coldstart_row(row: dict) -> list:
+    """serve_coldstart rows must carry both raw boot times so the
+    speedup `value` stays auditable."""
+    missing = [f for f in COLDSTART_FIELDS if f not in row]
+    if missing:
+        return [
+            f"row 'serve_coldstart': missing field(s) {missing} — "
+            f"the verified-cache vs compile boot comparison must "
+            f"record both raw measurements"
+        ]
+    return []
 
 
 def check_bundle(path: str) -> list:
