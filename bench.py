@@ -1689,6 +1689,7 @@ def bench_serve_fleet_loadtest(window_s=None):
                 raise RuntimeError(f"cache reboot refused: "
                                    f"{p.boot_line}")
             procs[victim] = p
+            addrs[victim] = f"127.0.0.1:{port}"
             router.set_address(victim, f"127.0.0.1:{port}")
             deadline = time.monotonic() + 10
             rejoined = False
@@ -1700,6 +1701,46 @@ def bench_serve_fleet_loadtest(window_s=None):
             kill["rejoined"] = rejoined
             kill["rejoin_boot"] = "verified-cache"
             points.append(kill)
+
+            # fleet-aggregated observability fields (ISSUE 17): scrape
+            # every replica's registry over metricz, merge the
+            # admitted-latency histograms bucket-wise, and quote the
+            # fleet p99 from the MERGED buckets — cross-checked (by
+            # check_bench_record's compare rule) against the router's
+            # own end-to-end timing of the same admitted requests
+            from paddle_tpu.obs import aggregate as obs_agg
+            from paddle_tpu.obs import metrics as obs_metrics
+            from paddle_tpu.serving.tcp import ServeClient
+
+            snaps = {}
+            bench_scrape_failures = 0
+            for name, addr in addrs.items():
+                try:
+                    c = ServeClient(addr, retries=0, admin_timeout=2.0)
+                    resp = c.metricz()
+                    c.close()
+                    snaps[name] = resp.get("metricz", {})
+                except Exception:
+                    bench_scrape_failures += 1
+            merged = obs_agg.merge_snapshots(snaps)
+            fleet_hist = obs_agg.family_histogram(
+                merged["histograms"], "serving.admitted_latency_s")
+            fleet_p99 = obs_agg.quantile(fleet_hist, 0.99)
+            local = obs_metrics.get_registry().snapshot()
+            router_hist = obs_agg.family_histogram(
+                local["histograms"], "fleet.request_latency_s")
+            router_p99 = obs_agg.quantile(router_hist, 0.99)
+            fleet_agg = {
+                "fleet_p99_ms": round(fleet_p99 * 1e3, 3)
+                if fleet_p99 is not None else None,
+                "router_p99_ms": round(router_p99 * 1e3, 3)
+                if router_p99 is not None else None,
+                "fleet_alerts": int(obs_agg.family_total(
+                    local["counters"], "fleet.alerts")),
+                "fleet_scrape_errors": int(obs_agg.family_total(
+                    local["counters"], "fleet.scrape_errors"))
+                + bench_scrape_failures,
+            }
         finally:
             router.close()
     finally:
@@ -1708,7 +1749,7 @@ def bench_serve_fleet_loadtest(window_s=None):
         shutil.rmtree(cache_dir, ignore_errors=True)
 
     total_lost = sum(pt["admitted_lost"] for pt in points)
-    return {
+    row = {
         "value": kill["goodput_rps"],
         "unit": "fleet goodput req/s through a replica SIGKILL",
         "points": points,
@@ -1720,6 +1761,8 @@ def bench_serve_fleet_loadtest(window_s=None):
         "window_s": window,
         "clients": n_clients,
     }
+    row.update(fleet_agg)
+    return row
 
 
 def bench_serve_coldstart(layers=None, d=256):

@@ -578,6 +578,147 @@ def _metrics_spans(args):
     return 0
 
 
+def cmd_fleetz(args):
+    """Live fleet snapshot (ISSUE 17): scrape every replica's metricz
+    twice, `--interval` apart, merge the snapshots into one fleet
+    view (counters summed, histograms merged bucket-wise), and print
+    a per-replica health table + fleet quantiles + active threshold
+    breaches. Deliberately jax-free, like `metrics`: the operator box
+    watching a fleet must not need a device runtime."""
+    import time as _t
+
+    from paddle_tpu.obs import aggregate as agg
+    from paddle_tpu.serving.tcp import ServeClient
+
+    replicas = {}
+    for i, spec in enumerate(args.addr):
+        if "=" in spec:
+            name, _, a = spec.partition("=")
+        else:
+            name, a = f"r{i}", spec
+        replicas[name] = a
+
+    def scrape():
+        snaps, stats, errors = {}, {}, {}
+        for name, a in replicas.items():
+            try:
+                c = ServeClient(a, retries=0,
+                                admin_timeout=args.timeout)
+                resp = c.metricz()
+                c.close()
+                snaps[name] = resp.get("metricz", {})
+                stats[name] = resp.get("stats", {})
+            except Exception as e:
+                errors[name] = f"{type(e).__name__}: {e}"
+        return snaps, stats, errors
+
+    t0 = _t.time()
+    first, _, _ = scrape()
+    _t.sleep(args.interval)
+    second, stats, errors = scrape()
+    dt = _t.time() - t0
+
+    both = {n: s for n, s in second.items() if n in first}
+    prev = agg.merge_snapshots({n: first[n] for n in both})
+    cur = agg.merge_snapshots(second)
+    delta = agg.snapshot_delta(prev, cur)
+    rates = agg.counter_rates(delta, dt)
+
+    family_sum = agg.family_total
+
+    def merged_latency(histograms):
+        """All serving.admitted_latency_s series (one per model)
+        folded into one distribution."""
+        return agg.family_histogram(histograms,
+                                    "serving.admitted_latency_s")
+
+    table = []
+    for name in sorted(replicas):
+        if name in errors:
+            table.append({"replica": name, "up": False,
+                          "error": errors[name]})
+            continue
+        st = stats.get(name, {}) or {}
+        dsnap = agg.snapshot_delta(
+            agg.merge_snapshots({name: first.get(name, {})}),
+            agg.merge_snapshots({name: second.get(name, {})}),
+        )
+        admitted = family_sum(dsnap["counters"], "serving.admitted")
+        shed = family_sum(dsnap["counters"], "serving.shed")
+        total = admitted + shed
+        lat = merged_latency(dsnap["histograms"])
+        p99 = agg.quantile(lat, 0.99) if lat else None
+        table.append({
+            "replica": name,
+            "up": True,
+            "queue_depth": st.get("queue_depth"),
+            "admitted": admitted,
+            "shed": shed,
+            "shed_frac": round(shed / total, 4) if total else 0.0,
+            "p99_ms": round(p99 * 1e3, 3) if p99 is not None else None,
+        })
+
+    fleet_lat = merged_latency(delta["histograms"])
+    fleet = {
+        "replicas_up": sum(1 for r in table if r.get("up")),
+        "replicas_down": sum(1 for r in table if not r.get("up")),
+        "admitted_rate_rps": round(
+            family_sum(rates, "serving.admitted"), 3),
+        "shed_rate_rps": round(family_sum(rates, "serving.shed"), 3),
+        "p50_ms": None,
+        "p99_ms": None,
+    }
+    for q, key in ((0.50, "p50_ms"), (0.99, "p99_ms")):
+        v = agg.quantile(fleet_lat, q) if fleet_lat else None
+        fleet[key] = round(v * 1e3, 3) if v is not None else None
+
+    alerts = []
+    for r in table:
+        if not r.get("up"):
+            alerts.append({"alert": "replica_down",
+                           "replica": r["replica"]})
+            continue
+        if args.slo_ms > 0 and r.get("p99_ms") is not None \
+                and r["p99_ms"] > args.slo_ms:
+            alerts.append({"alert": "p99_slo", "replica": r["replica"],
+                           "p99_ms": r["p99_ms"],
+                           "slo_ms": args.slo_ms})
+        if r.get("shed_frac", 0.0) > args.shed_threshold:
+            alerts.append({"alert": "shedding", "replica": r["replica"],
+                           "shed_frac": r["shed_frac"]})
+
+    if args.json:
+        print(json.dumps({"interval_s": round(dt, 3),
+                          "replicas": table, "fleet": fleet,
+                          "alerts": alerts}, indent=2))
+        return 1 if alerts else 0
+    print(f"fleet of {len(replicas)} replicas "
+          f"({fleet['replicas_up']} up), {dt:.1f}s window")
+    print(f"{'replica':12s} {'state':6s} {'queue':>6s} {'adm':>8s} "
+          f"{'shed':>8s} {'shed%':>7s} {'p99_ms':>9s}")
+    for r in table:
+        if not r.get("up"):
+            print(f"{r['replica']:12s} {'DOWN':6s} {r['error']}")
+            continue
+        p99 = f"{r['p99_ms']:9.3f}" if r["p99_ms"] is not None \
+            else f"{'-':>9s}"
+        print(f"{r['replica']:12s} {'up':6s} "
+              f"{str(r['queue_depth'] if r['queue_depth'] is not None else '-'):>6s} "
+              f"{r['admitted']:8.0f} {r['shed']:8.0f} "
+              f"{100 * r['shed_frac']:6.1f}% {p99}")
+    print(f"fleet: {fleet['admitted_rate_rps']} rps admitted, "
+          f"{fleet['shed_rate_rps']} rps shed, "
+          f"p50={fleet['p50_ms']} ms p99={fleet['p99_ms']} ms "
+          f"(merged buckets)")
+    if alerts:
+        print("active alerts:")
+        for a in alerts:
+            print("  " + json.dumps(a))
+    else:
+        print("no active alerts")
+    return 1 if alerts else 0
+
+
 def cmd_make_diagram(args):
     """Emit a graphviz .dot of the layer graph (the reference's
     `paddle make_diagram`, scripts/submit_local.sh.in:3-13)."""
@@ -699,6 +840,29 @@ def main(argv=None):
     sp.add_argument("--top", type=int, default=10,
                     help="with --spans: slowest traces to list")
     sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser(
+        "fleetz",
+        help="live fleet snapshot: scrape replicas' metricz, merge "
+             "into one fleet view (per-replica health table, fleet "
+             "p50/p99 from merged buckets, active alerts)",
+    )
+    sp.add_argument("--addr", action="append", required=True,
+                    help="replica address, repeatable: host:port or "
+                         "name=host:port")
+    sp.add_argument("--interval", type=float, default=1.0,
+                    help="seconds between the two scrapes the "
+                         "delta/rate view is computed over")
+    sp.add_argument("--timeout", type=float, default=2.0,
+                    help="per-replica scrape timeout")
+    sp.add_argument("--slo-ms", type=float, default=0.0,
+                    dest="slo_ms",
+                    help="admitted-p99 SLO in ms (0 = no p99 alert)")
+    sp.add_argument("--shed-threshold", type=float, default=0.5,
+                    dest="shed_threshold",
+                    help="per-replica shed-fraction alert threshold")
+    sp.add_argument("--json", action="store_true")
+    sp.set_defaults(fn=cmd_fleetz)
 
     sp = sub.add_parser("make_diagram", help="emit graphviz dot of a config")
     sp.add_argument("--config", required=True)

@@ -56,6 +56,28 @@ FLEET_KILL_FIELDS = ("goodput_rps", "admitted_lost")
 # speedup claim stays auditable against its raw measurements
 COLDSTART_FIELDS = ("cache_boot_s", "compile_boot_s")
 
+# fleet-aggregated observability fields the serve_fleet_loadtest row
+# must carry (ISSUE 17): the fleet p99 merged bucket-wise from the
+# replicas' own admitted-latency histograms, the router's independent
+# end-to-end p99 of the same requests, and the alert/scrape-failure
+# accounting. The two p99s are measured through DIFFERENT pipes
+# (replica-side histogram scrape vs router-side wall clock), so their
+# agreement — within the tolerances below — is the cross-check that
+# the whole scrape→merge→quantile chain is wired to reality.
+FLEET_AGG_FIELDS = (
+    "fleet_p99_ms", "router_p99_ms", "fleet_alerts",
+    "fleet_scrape_errors",
+)
+
+# agreement tolerance: the fleet p99 is a bucket-boundary upper bound
+# (default buckets step ~2x) and the router p99 includes routing +
+# socket time on a loaded CPU CI box, while a mid-sweep replica
+# respawn drops pre-kill samples from the scraped side — so the
+# ratio bound is generous, with a small absolute floor for the
+# sub-millisecond toy-model regime
+FLEET_P99_RATIO_TOL = 3.0
+FLEET_P99_ABS_TOL_MS = 30.0
+
 # north-star rows that must carry the timeline triple (ISSUE 10).
 # MUST equal bench.py's NORTH_STARS — check_bench_record's static
 # mode enforces the sync.

@@ -77,6 +77,20 @@ _DEFAULTS: dict[str, Any] = {
     "serve_p99_slo_ms": 0,
     "serve_shed_rate_threshold": 0.5,
     "serve_shed_window_s": 5.0,
+    # fleet SLO burn-rate monitor (obs/aggregate.py wired into
+    # serving/fleet.py, ISSUE 17): availability target, the
+    # fast/slow multi-window burn-rate pairs (Google-SRE style: an
+    # alert needs the budget burning in BOTH the short window and its
+    # long companion), and the incident-bundle dump discipline (same
+    # rate-limit + bounded-dir contract as the flight recorder)
+    "fleet_availability_target": 0.999,
+    "fleet_burn_fast_window_s": 60.0,
+    "fleet_burn_fast_threshold": 14.4,
+    "fleet_burn_slow_window_s": 300.0,
+    "fleet_burn_slow_threshold": 6.0,
+    "fleet_burn_min_decisions": 20,
+    "fleet_incident_min_interval_s": 60.0,
+    "fleet_incident_max_bundles": 8,
     # data
     "prefetch_depth": 2,
     # kernels: None = auto (fused Pallas cells on TPU, lax.scan elsewhere)

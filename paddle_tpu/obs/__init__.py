@@ -27,8 +27,17 @@ from paddle_tpu.obs.metrics import (  # noqa: F401
 from paddle_tpu.obs.timeline import StepTimeline  # noqa: F401
 from paddle_tpu.obs import tracing  # noqa: F401
 from paddle_tpu.obs.flight_recorder import (  # noqa: F401
+    BoundedBundleDir,
     FlightRecorder,
     enable_flight_recorder,
     disable_flight_recorder,
     get_flight_recorder,
+)
+from paddle_tpu.obs.aggregate import (  # noqa: F401
+    BurnRateMonitor,
+    FleetAggregator,
+    SnapshotMergeError,
+    merge_snapshots,
+    quantile,
+    snapshot_delta,
 )

@@ -50,6 +50,84 @@ from paddle_tpu.obs import metrics as _metrics
 BUNDLE_SCHEMA = "paddle-tpu-flight-bundle/v1"
 
 
+class BoundedBundleDir:
+    """The shared dump discipline for bundle writers (flight bundles
+    here, fleet incident bundles in serving/fleet.py): rate limiting,
+    sequence numbering, atomic writes, and bounded-dir rotation are
+    ONE implementation, not a copy per bundle kind.
+
+    Contract (pinned by test):
+
+    - `try_begin()` hands out a sequence number at most once per
+      `min_interval_s`; a suppressed trigger returns None (the caller
+      counts the suppression on its own counter, so flight and
+      incident suppressions stay separately attributable);
+    - `write(seq, reason, doc)` lands `{prefix}{seq:05d}-{reason}.json`
+      via tmp + `os.replace` (a bundle is complete or absent), then
+      prunes the dir down to `max_bundles` files with that prefix —
+      oldest first. With no `dump_dir` it returns None (ring-only /
+      in-memory mode: the caller keeps the doc itself)."""
+
+    def __init__(self, dump_dir: Optional[str],
+                 prefix: str = "flight-",
+                 max_bundles: int = 8,
+                 min_interval_s: float = 60.0,
+                 lock_name: str = "obs.bundle_dir"):
+        self.dump_dir = dump_dir
+        self.prefix = prefix
+        self.max_bundles = int(max_bundles)
+        self.min_interval_s = float(min_interval_s)
+        # a known lock (ISSUE 13): instrumented under the faults
+        # shard's lock-order checker (analysis/lock_order.py)
+        self._lock = named_lock(lock_name)
+        self._last_mono: Optional[float] = None
+        self._seq = 0
+        if dump_dir:
+            os.makedirs(dump_dir, exist_ok=True)
+
+    def try_begin(self) -> Optional[int]:
+        now = time.monotonic()
+        with self._lock:
+            if (self._last_mono is not None
+                    and now - self._last_mono < self.min_interval_s):
+                return None
+            self._last_mono = now
+            self._seq += 1
+            return self._seq
+
+    def path_for(self, seq: int, reason: str) -> Optional[str]:
+        if not self.dump_dir:
+            return None
+        return os.path.join(
+            self.dump_dir, f"{self.prefix}{seq:05d}-{reason}.json"
+        )
+
+    def write(self, seq: int, reason: str, doc: dict) -> Optional[str]:
+        path = self.path_for(seq, reason)
+        if path is None:
+            return None
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, default=str)
+        os.replace(tmp, path)  # a bundle is complete or absent
+        self.prune()
+        return path
+
+    def prune(self) -> None:
+        try:
+            bundles = sorted(
+                f for f in os.listdir(self.dump_dir)
+                if f.startswith(self.prefix) and f.endswith(".json")
+            )
+        except (OSError, TypeError):
+            return
+        for f in bundles[: max(len(bundles) - self.max_bundles, 0)]:
+            try:
+                os.remove(os.path.join(self.dump_dir, f))
+            except OSError:
+                pass
+
+
 class FlightRecorder:
     """Ring buffer + bundle writer. Attach to a registry with
     `enable_flight_recorder()` (production) or construct privately
@@ -66,29 +144,40 @@ class FlightRecorder:
             capacity if capacity is not None
             else _flags.get_flag("flight_ring_capacity")
         )
-        self.min_interval_s = float(
-            min_interval_s if min_interval_s is not None
-            else _flags.get_flag("flight_min_dump_interval_s")
-        )
-        self.max_bundles = int(
-            max_bundles if max_bundles is not None
-            else _flags.get_flag("flight_max_bundles")
-        )
         self.profiler_capture = bool(
             profiler_capture if profiler_capture is not None
             else _flags.get_flag("flight_profiler_capture")
+        )
+        # rate limiting / seq / atomic write / rotation all live in
+        # the shared BoundedBundleDir (one dump discipline for flight
+        # AND fleet-incident bundles, ISSUE 17 satellite)
+        self._dir = BoundedBundleDir(
+            dump_dir,
+            prefix="flight-",
+            max_bundles=int(
+                max_bundles if max_bundles is not None
+                else _flags.get_flag("flight_max_bundles")
+            ),
+            min_interval_s=float(
+                min_interval_s if min_interval_s is not None
+                else _flags.get_flag("flight_min_dump_interval_s")
+            ),
         )
         self._reg = registry or _metrics.get_registry()
         self._ring = collections.deque(maxlen=self.capacity)
         # a known lock (ISSUE 13): instrumented under the faults
         # shard's lock-order checker (analysis/lock_order.py)
         self._lock = named_lock("obs.flight_ring")
-        self._last_dump_mono: Optional[float] = None
-        self._seq = 0
         self.last_bundle: Optional[dict] = None
         self.last_bundle_path: Optional[str] = None
-        if dump_dir:
-            os.makedirs(dump_dir, exist_ok=True)
+
+    @property
+    def min_interval_s(self) -> float:
+        return self._dir.min_interval_s
+
+    @property
+    def max_bundles(self) -> int:
+        return self._dir.max_bundles
 
     # ---- ring (called from registry.event via the recorder tap) ----
     def record(self, obj: dict) -> None:
@@ -110,18 +199,13 @@ class FlightRecorder:
         less than `min_interval_s` ago (then: count the suppression,
         return None). Never raises — the recorder must not be able to
         take down the subsystem that tripped it."""
-        now = time.monotonic()
-        with self._lock:
-            if (self._last_dump_mono is not None
-                    and now - self._last_dump_mono < self.min_interval_s):
-                self._reg.counter("flight.dumps_suppressed").inc(
-                    reason=reason
-                )
-                return None
-            self._last_dump_mono = now
-            self._seq += 1
-            seq = self._seq
-            events = list(self._ring)
+        seq = self._dir.try_begin()
+        if seq is None:
+            self._reg.counter("flight.dumps_suppressed").inc(
+                reason=reason
+            )
+            return None
+        events = self.snapshot()
         try:
             return self._dump(reason, context, events, seq)
         except Exception:
@@ -142,39 +226,19 @@ class FlightRecorder:
             "metrics": self._reg.snapshot(),
             "profile": {"captured": False},
         }
-        if not self.dump_dir:
+        path = self._dir.path_for(seq, reason)
+        if path is None:
             # ring-only mode (bench rows, tests reading spans()):
             # nothing to write, but the trigger is still counted and
             # the bundle is handed back in-memory via last_bundle
             self.last_bundle = bundle
             return None
-        path = os.path.join(
-            self.dump_dir, f"flight-{seq:05d}-{reason}.json"
-        )
         if self.profiler_capture:
             bundle["profile"] = _profiler_capture(path)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(bundle, f, default=str)
-        os.replace(tmp, path)  # a bundle is complete or absent
+        path = self._dir.write(seq, reason, bundle)
         self.last_bundle = bundle
         self.last_bundle_path = path
-        self._prune()
         return path
-
-    def _prune(self) -> None:
-        try:
-            bundles = sorted(
-                f for f in os.listdir(self.dump_dir)
-                if f.startswith("flight-") and f.endswith(".json")
-            )
-        except OSError:
-            return
-        for f in bundles[: max(len(bundles) - self.max_bundles, 0)]:
-            try:
-                os.remove(os.path.join(self.dump_dir, f))
-            except OSError:
-                pass
 
 
 def _profiler_capture(bundle_path: str, duration_s: float = 0.5) -> dict:

@@ -219,6 +219,11 @@ class Histogram:
             self._series.clear()
 
     def snapshot(self) -> dict:
+        # bounds + per-bucket counts ride the snapshot (ISSUE 17): a
+        # metricz scrape must carry everything obs/aggregate.py needs
+        # to merge N replicas' histograms bucket-wise, so fleet
+        # p50/p99 come from merged buckets rather than averaged
+        # per-replica quantiles (which are not mergeable)
         with self._lock:
             out = {}
             for k, s in sorted(self._series.items()):
@@ -228,6 +233,8 @@ class Histogram:
                     "min": s.min if s.count else None,
                     "max": s.max,
                     "avg": s.sum / s.count if s.count else 0.0,
+                    "bounds": list(self.bounds),
+                    "buckets": list(s.bucket_counts),
                 }
             return out
 

@@ -16,15 +16,33 @@ CLI: `python -m paddle_tpu serve --config serve_conf.py [--port N]`
 where the config defines `get_server() -> InferenceServer`.
 """
 
-from paddle_tpu.serving.server import (  # noqa: F401
-    InferenceServer,
-    PendingResult,
-    ServeConfig,
-    ServeError,
-    ServeRejected,
-)
-from paddle_tpu.serving.fleet import (  # noqa: F401
-    FleetConfig,
-    FleetRouter,
-    ReplicaHandle,
-)
+# Lazy exports (PEP 562): `server` transitively needs jax (batch
+# formation uses data.feeder), but the TCP client and the fleetz /
+# fleet_view operator surface must import without the device runtime
+# (ISSUE 17) — so nothing here may eagerly drag server in.
+_EXPORTS = {
+    "InferenceServer": "paddle_tpu.serving.server",
+    "PendingResult": "paddle_tpu.serving.server",
+    "ServeConfig": "paddle_tpu.serving.server",
+    "ServeError": "paddle_tpu.serving.server",
+    "ServeRejected": "paddle_tpu.serving.server",
+    "FleetConfig": "paddle_tpu.serving.fleet",
+    "FleetRouter": "paddle_tpu.serving.fleet",
+    "ReplicaHandle": "paddle_tpu.serving.fleet",
+    "RolloutReport": "paddle_tpu.serving.fleet",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        value = getattr(importlib.import_module(_EXPORTS[name]), name)
+        globals()[name] = value
+        return value
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

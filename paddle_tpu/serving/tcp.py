@@ -7,6 +7,7 @@ Frame = 4-byte LE length + UTF-8 JSON. Request:
      "trace": {"trace_id": str, "span_id": str}?}   # trace carrier
   | {"metricz": true}          # telemetry scrape (no inference)
   | {"tracez": true, "top": int?}   # slow-request exemplars
+  | {"flightz": true}          # flight-ring dump (incident stitch)
   | {"admin": "swap_model", "model": str, "tag": str?}  # hot-swap
 
 Response:
@@ -19,6 +20,8 @@ Response:
   | {"ok": true, "metricz": <registry snapshot>, "stats": <server
      stats>}                   # for a metricz request
   | {"ok": true, "tracez": [exemplar, ...]}   # for a tracez request
+  | {"ok": true, "flightz": {"pid": int, "enabled": bool,
+     "events": [...], "capacity": int}}   # for a flightz request
 
 The `trace` carrier makes one trace_id span the whole request path:
 the client's `client.request` span, the server's `serve.request` root
@@ -46,19 +49,30 @@ being served.
 from __future__ import annotations
 
 import json
+import os
 import random
 import socket
 import struct
 import threading
 import time
 
+from paddle_tpu.obs import flight_recorder as _flight
 from paddle_tpu.obs import metrics as _obs
 from paddle_tpu.obs import tracing as _tracing
-from paddle_tpu.serving.server import (
-    InferenceServer,
-    ServeError,
-    ServeRejected,
-)
+# `server` transitively needs jax (batch formation); the CLIENT half
+# of this module must stay importable without the device runtime
+# (fleetz / fleet_view, ISSUE 17), so the server-side exception types
+# resolve lazily — by the time ServingTCPServer handles a request,
+# server.py is necessarily already imported (it wraps an
+# InferenceServer instance).
+if False:  # typing only — never executed
+    from paddle_tpu.serving.server import InferenceServer  # noqa
+
+
+def _server_errors():
+    from paddle_tpu.serving.server import ServeError, ServeRejected
+
+    return ServeError, ServeRejected
 
 _MAX_FRAME = 1 << 24  # 16 MiB of JSON is garbage, not a request
 
@@ -206,6 +220,21 @@ class ServingTCPServer:
                 "ok": True,
                 "tracez": self.server.slow_exemplars(top=top),
             }
+        if isinstance(msg, dict) and msg.get("flightz"):
+            # flight-ring dump for cross-process incident stitching
+            # (ISSUE 17): like metricz, answered OUTSIDE the admission
+            # queue — an overloaded replica is exactly the one whose
+            # ring an incident bundle needs
+            rec = _flight.get_flight_recorder()
+            return {
+                "ok": True,
+                "flightz": {
+                    "pid": os.getpid(),
+                    "enabled": rec is not None,
+                    "events": rec.snapshot() if rec is not None else [],
+                    "capacity": rec.capacity if rec is not None else 0,
+                },
+            }
         if isinstance(msg, dict) and msg.get("admin") == "swap_model":
             # zero-downtime hot swap: runs on this connection's handler
             # thread while every other connection keeps serving. The
@@ -239,6 +268,7 @@ class ServingTCPServer:
             trace = msg.get("trace")
         except (KeyError, TypeError):
             return {"ok": False, "error": "bad_request"}
+        ServeError, ServeRejected = _server_errors()
         try:
             req = self.server.submit(model, ids, deadline_s=deadline_s,
                                      hooks_name=hooks_name, trace=trace)
@@ -338,7 +368,8 @@ class ServeClient:
 
     def __init__(self, addr: str, connect_timeout: float = 5.0,
                  retries: int = 3, backoff_s: float = 0.05,
-                 backoff_max_s: float = 1.0):
+                 backoff_max_s: float = 1.0,
+                 admin_timeout: float = 5.0):
         host, _, port = addr.rpartition(":")
         self._host = host or "127.0.0.1"
         self._port = int(port)
@@ -346,6 +377,12 @@ class ServeClient:
         self._retries = max(0, int(retries))
         self._backoff_s = backoff_s
         self._backoff_max_s = backoff_max_s
+        # admin frames (metricz/tracez/flightz) default to a BOUNDED
+        # timeout distinct from the request path: a black-holed
+        # replica must cost the fleet poller `admin_timeout`, not a
+        # thread wedged forever (ISSUE 17 satellite, pinned with
+        # FlakyProxy.black_hole)
+        self._admin_timeout = admin_timeout
         self._sock = None
 
     def _connect(self):
@@ -399,12 +436,21 @@ class ServeClient:
 
     def metricz(self, timeout: float = None) -> dict:
         """Scrape the server's registry snapshot + stats."""
-        return self._roundtrip({"metricz": True}, timeout)
+        return self._roundtrip({"metricz": True},
+                               self._admin(timeout))
 
     def tracez(self, top: int = 10, timeout: float = None) -> dict:
         """Scrape the server's slow-request exemplars."""
         return self._roundtrip({"tracez": True, "top": int(top)},
-                               timeout)
+                               self._admin(timeout))
+
+    def flightz(self, timeout: float = None) -> dict:
+        """Fetch the server's flight-ring dump (incident stitching)."""
+        return self._roundtrip({"flightz": True},
+                               self._admin(timeout))
+
+    def _admin(self, timeout):
+        return timeout if timeout is not None else self._admin_timeout
 
     def _roundtrip(self, msg: dict, timeout: float = None) -> dict:
         if self._sock is None:

@@ -273,6 +273,14 @@ import numpy as np
 
 from paddle_tpu.serving.server import InferenceServer, ServeConfig
 from paddle_tpu.serving.tcp import ServingTCPServer
+from paddle_tpu.obs import flight_recorder as _fr
+
+# every replica keeps a flight ring (ring-only unless
+# PADDLE_FLIGHT_DIR points somewhere): the fleet router's incident
+# bundles stitch replica rings over the flightz frame, so a replica
+# without a ring is a blind spot in every cross-process incident
+_fr.enable_flight_recorder(
+    dump_dir=os.environ.get("PADDLE_FLIGHT_DIR") or None)
 
 mode = os.environ.get("REPLICA_MODE", "toy")   # toy | cache | compile
 model_name = os.environ.get("MODEL_NAME", "m")

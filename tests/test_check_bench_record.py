@@ -273,6 +273,9 @@ def test_compare_fleet_row_schema(tmp_path):
         "admitted_lost": 0,
         "kill": {"goodput_rps": 100.0, "p99_ms": 8.0,
                  "admitted_lost": 0},
+        # fleet-aggregated observability (ISSUE 17)
+        "fleet_p99_ms": 9.0, "router_p99_ms": 10.0,
+        "fleet_alerts": 0, "fleet_scrape_errors": 2,
     }
     assert lint(good) == []
     # kill dict missing entirely
@@ -295,6 +298,88 @@ def test_compare_fleet_row_schema(tmp_path):
     # errored rows stay exempt
     assert lint({"metric": "serve_fleet_loadtest", "value": None,
                  "error": "x"}) == []
+
+
+def test_compare_fleet_row_aggregated_fields(tmp_path):
+    """ISSUE 17: the fleet row must carry the merged-histogram fleet
+    p99, the router's own p99 as an independent cross-check, and the
+    alert/scrape-failure accounting — and the two p99s must agree
+    within tolerance (they time the same admitted requests via
+    disjoint pipes)."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    good = {
+        "metric": "serve_fleet_loadtest", "value": 100.0,
+        "admitted_lost": 0,
+        "kill": {"goodput_rps": 100.0, "p99_ms": 8.0,
+                 "admitted_lost": 0},
+        "fleet_p99_ms": 9.0, "router_p99_ms": 10.0,
+        "fleet_alerts": 1, "fleet_scrape_errors": 3,
+    }
+    assert lint(good) == []
+    # any aggregated field silently dropped -> violation naming it
+    for f in cbr.FLEET_AGG_FIELDS:
+        row = dict(good)
+        del row[f]
+        v = lint(row)
+        assert any(f in x for x in v), (f, v)
+    # a merge that produced nothing is a broken scrape chain
+    for bad in (0, None, "nan"):
+        v = lint(dict(good, fleet_p99_ms=bad))
+        assert any("fleet_p99_ms" in x for x in v), (bad, v)
+    # p99s disagreeing beyond BOTH the ratio and absolute tolerance
+    v = lint(dict(good, fleet_p99_ms=500.0, router_p99_ms=10.0))
+    assert any("disagree" in x for x in v)
+    # inside tolerance: small absolute gaps in the sub-ms toy regime
+    # are fine even when the ratio is large...
+    assert lint(dict(good, fleet_p99_ms=3.0, router_p99_ms=0.5)) == []
+    # ...and a large absolute gap is fine while the ratio is modest
+    assert lint(dict(good, fleet_p99_ms=900.0,
+                     router_p99_ms=400.0)) == []
+
+
+def test_bundle_lint_incident(tmp_path):
+    """`check_bundle` dispatches on the incident schema tag and
+    validates the cross-process stitch: required fields, typed
+    alerts, the fleet stanza, and span events in EVERY ring (the
+    router's own plus each replica's flightz dump)."""
+    span = {"kind": "span", "name": "a", "trace_id": "t",
+            "span_id": "s", "parent_id": "", "ts": 1.0,
+            "dur_s": 0.1, "status": "ok"}
+    good = {
+        "schema": "paddle-tpu-fleet-incident/v1",
+        "reason": "burn_rate", "ts": 1.0, "pid": 1, "seq": 1,
+        "alerts": [{"alert": "p99_slo", "p99_short_ms": 9.0}],
+        "offending": "r1",
+        "states": {}, "events": [span],
+        "replicas": {"r1": {"pid": 2, "enabled": True,
+                            "events": [span]}},
+        "fleet": {"merged": {"counters": {}}, "delta": None,
+                  "rates": None},
+    }
+    p = tmp_path / "incident-00001-burn_rate.json"
+    p.write_text(json.dumps(good))
+    assert cbr.check_bundle(str(p)) == []
+    # missing required field
+    bad = dict(good)
+    del bad["fleet"]
+    p.write_text(json.dumps(bad))
+    assert any("'fleet'" in x for x in cbr.check_bundle(str(p)))
+    # untyped alert entries
+    p.write_text(json.dumps(dict(good, alerts=[{"oops": 1}])))
+    assert any("alert" in x for x in cbr.check_bundle(str(p)))
+    # a replica ring with a malformed span event is caught too
+    torn = dict(span)
+    del torn["dur_s"]
+    p.write_text(json.dumps(dict(
+        good, replicas={"r1": {"events": [torn]}})))
+    assert any("dur_s" in x for x in cbr.check_bundle(str(p)))
 
 
 def test_compare_coldstart_row_schema(tmp_path):

@@ -1,0 +1,431 @@
+"""Fleet snapshot aggregation + burn-rate monitor (ISSUE 17).
+
+The unit half of the fleet observability plane, jax-free throughout:
+
+- `merge_snapshots` semantics: counters summed, gauges kept as
+  per-replica labeled series, histograms merged bucket-wise with
+  EXACT count/sum/min/max — plus the refusal cases (kind conflict,
+  mismatched bucket boundaries) and the legal edge cases (empty
+  replica, merge racing a `reset_prefix`, concurrent multi-thread
+  load with exactness preserved).
+- `quantile` from merged le-buckets: upper-bound estimates, the +inf
+  overflow bucket resolving to the exact max.
+- `snapshot_delta` / `counter_rates`: between-scrape views with
+  counter-reset (replica restart) handling.
+- `BurnRateMonitor`: no alert inside budget, the two-window rule
+  suppressing blips, rising-edge alert counting, per-replica offender
+  attribution, and the p99-over-SLO alert.
+- `BoundedBundleDir`: the ONE dump-discipline implementation flight
+  bundles and fleet incident bundles now share — rate limit, atomic
+  write, oldest-first rotation, in-memory mode.
+- the jax-free import pin for `obs/aggregate.py` and
+  `tools/fleet_view.py` (subprocess with jax import-blocked).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from paddle_tpu.obs import aggregate as agg  # noqa: E402
+from paddle_tpu.obs import flight_recorder as fr  # noqa: E402
+from paddle_tpu.obs import metrics as om  # noqa: E402
+
+
+def _reg_with(counters=(), gauges=(), hists=(), buckets=None):
+    reg = om.MetricsRegistry()
+    for name, labels, v in counters:
+        reg.counter(name).inc(v, **labels)
+    for name, labels, v in gauges:
+        reg.gauge(name).set(v, **labels)
+    for name, labels, vals in hists:
+        h = reg.histogram(name, buckets=buckets)
+        for v in vals:
+            h.observe(v, **labels)
+    return reg
+
+
+# ==================================================== merge semantics
+class TestMergeSnapshots:
+    def test_counters_sum_gauges_label_histograms_merge(self):
+        r0 = _reg_with(
+            counters=[("req", {"model": "m"}, 3.0)],
+            gauges=[("queue_depth", {}, 5.0)],
+            hists=[("lat", {"model": "m"}, [0.001, 0.01, 0.2])],
+        )
+        r1 = _reg_with(
+            counters=[("req", {"model": "m"}, 4.0)],
+            gauges=[("queue_depth", {}, 9.0)],
+            hists=[("lat", {"model": "m"}, [0.002, 0.5])],
+        )
+        m = agg.merge_snapshots({"a": r0.snapshot(),
+                                 "b": r1.snapshot()})
+        assert m["replicas"] == ["a", "b"]
+        assert m["counters"]["req{model=m}"] == 7.0
+        # gauges are NOT summed: per-replica labeled series survive
+        assert m["gauges"]["queue_depth{replica=a}"] == 5.0
+        assert m["gauges"]["queue_depth{replica=b}"] == 9.0
+        h = m["histograms"]["lat{model=m}"]
+        assert h["count"] == 5
+        assert h["sum"] == pytest.approx(0.001 + 0.01 + 0.2
+                                         + 0.002 + 0.5)
+        assert h["min"] == 0.001 and h["max"] == 0.5
+        # bucket-wise: total bucket mass equals total count
+        assert sum(h["buckets"]) == 5
+        assert h["bounds"] == list(om.DEFAULT_BUCKETS)
+
+    def test_kind_conflict_refuses(self):
+        r0 = _reg_with(counters=[("x", {}, 1.0)])
+        r1 = _reg_with(gauges=[("x", {}, 1.0)])
+        with pytest.raises(agg.SnapshotMergeError, match="counter"):
+            agg.merge_snapshots({"a": r0.snapshot(),
+                                 "b": r1.snapshot()})
+
+    def test_mismatched_bucket_bounds_refuse(self):
+        r0 = _reg_with(hists=[("lat", {}, [0.1])],
+                       buckets=(0.01, 0.1, 1.0))
+        r1 = _reg_with(hists=[("lat", {}, [0.1])],
+                       buckets=(0.05, 0.5))
+        with pytest.raises(agg.SnapshotMergeError,
+                           match="boundaries"):
+            agg.merge_snapshots({"a": r0.snapshot(),
+                                 "b": r1.snapshot()})
+
+    def test_empty_replica_is_legal(self):
+        r0 = _reg_with(counters=[("req", {}, 2.0)])
+        m = agg.merge_snapshots({
+            "a": r0.snapshot(),
+            "fresh": om.MetricsRegistry().snapshot(),
+            "none": None,
+        })
+        assert m["counters"]["req"] == 2.0
+        assert m["replicas"] == ["a", "fresh", "none"]
+
+    def test_merge_racing_reset_prefix(self):
+        """A replica scraped mid-`reset_prefix` hands over a
+        SELF-CONSISTENT snapshot (the registry snapshots under its
+        lock): the merge never errors and every merged histogram
+        keeps count == bucket mass."""
+        reg = _reg_with(hists=[("serving.lat", {}, [0.01] * 50)])
+        stop = threading.Event()
+
+        def resetter():
+            while not stop.is_set():
+                reg.reset_prefix("serving.")
+                h = reg.histogram("serving.lat")
+                for _ in range(20):
+                    h.observe(0.01)
+
+        t = threading.Thread(target=resetter)
+        t.start()
+        try:
+            for _ in range(200):
+                m = agg.merge_snapshots({"a": reg.snapshot()})
+                h = m["histograms"].get("serving.lat")
+                if h is not None and h["buckets"] is not None:
+                    assert sum(h["buckets"]) == h["count"]
+        finally:
+            stop.set()
+            t.join(10)
+
+    def test_concurrent_load_exactness(self):
+        """Fleet count/sum equals the sum over replicas, with every
+        replica being hammered from multiple threads while the merge
+        happens — the merge is exact arithmetic, not sampling."""
+        regs = {f"r{i}": om.MetricsRegistry() for i in range(3)}
+        n_threads, n_obs = 4, 500
+
+        def load(reg):
+            h = reg.histogram("lat")
+            c = reg.counter("req")
+            for k in range(n_obs):
+                h.observe(0.001 * (1 + k % 7))
+                c.inc()
+
+        ts = [threading.Thread(target=load, args=(reg,))
+              for reg in regs.values() for _ in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(30)
+        m = agg.merge_snapshots(
+            {name: reg.snapshot() for name, reg in regs.items()}
+        )
+        total = 3 * n_threads * n_obs
+        assert m["counters"]["req"] == total
+        h = m["histograms"]["lat"]
+        assert h["count"] == total
+        assert sum(h["buckets"]) == total
+        per_thread = sum(0.001 * (1 + k % 7) for k in range(n_obs))
+        assert h["sum"] == pytest.approx(3 * n_threads * per_thread,
+                                         rel=1e-6)
+
+
+# ==================================================== quantile + delta
+class TestQuantileAndDelta:
+    def test_quantile_upper_bound_walk(self):
+        reg = _reg_with(hists=[("lat", {},
+                                [0.005] * 90 + [0.08] * 10)],
+                        buckets=(0.001, 0.01, 0.1, 1.0))
+        h = reg.snapshot()["histograms"]["lat"]
+        assert agg.quantile(h, 0.50) == 0.01
+        assert agg.quantile(h, 0.99) == 0.1
+        assert agg.quantile(h, 0.0) == 0.01  # rank clamps to 1
+
+    def test_quantile_overflow_bucket_uses_max(self):
+        reg = _reg_with(hists=[("lat", {}, [5.0, 7.5])],
+                        buckets=(0.1, 1.0))
+        h = reg.snapshot()["histograms"]["lat"]
+        assert agg.quantile(h, 0.99) == 7.5
+
+    def test_quantile_empty_is_none(self):
+        reg = _reg_with(hists=[])
+        reg.histogram("lat")
+        h = reg.snapshot()["histograms"]
+        assert h == {} or agg.quantile(h.get("lat"), 0.5) is None
+        assert agg.quantile(None, 0.5) is None
+
+    def test_delta_and_rates(self):
+        prev = {"counters": {"req": 10.0}, "gauges": {},
+                "histograms": {}}
+        cur = {"replicas": ["a"], "counters": {"req": 25.0, "new": 3.0},
+               "gauges": {"depth{replica=a}": 4.0}, "histograms": {}}
+        d = agg.snapshot_delta(prev, cur)
+        assert d["counters"]["req"] == 15.0
+        assert d["counters"]["new"] == 3.0
+        assert d["gauges"]["depth{replica=a}"] == 4.0
+        rates = agg.counter_rates(d, 5.0)
+        assert rates["req"] == 3.0
+
+    def test_delta_counter_reset_takes_current(self):
+        """A replica restart zeroes its registry: the counter went
+        DOWN across scrapes, and the current value is the honest
+        delta (progress since restart), not a clamp to zero."""
+        d = agg.snapshot_delta({"counters": {"req": 100.0}},
+                               {"counters": {"req": 7.0}})
+        assert d["counters"]["req"] == 7.0
+
+    def test_histogram_delta_buckets(self):
+        r = om.MetricsRegistry()
+        h = r.histogram("lat", buckets=(0.01, 0.1))
+        h.observe(0.005)
+        first = agg.merge_snapshots({"a": r.snapshot()})
+        h.observe(0.05)
+        h.observe(0.05)
+        second = agg.merge_snapshots({"a": r.snapshot()})
+        d = agg.snapshot_delta(first, second)
+        e = d["histograms"]["lat"]
+        assert e["count"] == 2
+        assert e["buckets"] == [0, 2, 0]
+        assert agg.quantile(e, 0.5) == 0.1
+
+    def test_family_helpers(self):
+        r = om.MetricsRegistry()
+        r.counter("fleet.alerts").inc(2, alert="a")
+        r.counter("fleet.alerts").inc(3, alert="b")
+        h = r.histogram("lat")
+        h.observe(0.01, model="x")
+        h.observe(0.02, model="y")
+        snap = r.snapshot()
+        assert agg.family_total(snap["counters"], "fleet.alerts") == 5
+        fold = agg.family_histogram(snap["histograms"], "lat")
+        assert fold["count"] == 2
+
+    def test_aggregator_history_bounded(self):
+        fa = agg.FleetAggregator(history=4)
+        r = om.MetricsRegistry()
+        r.counter("req").inc()
+        for i in range(10):
+            fa.observe({"a": r.snapshot()}, ts=float(i))
+        hist = fa.history()
+        assert len(hist) == 4
+        assert hist[-1]["ts"] == 9.0
+        assert fa.rates is not None
+
+
+# ==================================================== burn-rate monitor
+class TestBurnRateMonitor:
+    def _mon(self, **kw):
+        kw.setdefault("availability_target", 0.9)  # budget = 0.1
+        kw.setdefault("windows", ((10.0, 50.0, 2.0),))
+        kw.setdefault("min_decisions", 10)
+        kw.setdefault("registry", om.MetricsRegistry())
+        return agg.BurnRateMonitor(**kw)
+
+    def test_no_alert_inside_budget(self):
+        m = self._mon()
+        for i in range(100):
+            m.record(i % 20 != 0, latency_s=0.01, now=100.0 + i * 0.1)
+        assert m.evaluate(now=110.0) == []
+        assert m.alerts_total == 0
+
+    def test_blip_suppressed_by_long_window(self):
+        """20 straight errors inside the short window burn hot, but
+        the long window has 200 earlier successes — the two-window
+        rule refuses to page on an already-bounded blip."""
+        m = self._mon()
+        for i in range(200):
+            m.record(True, latency_s=0.01, now=60.0 + i * 0.2)
+        for i in range(20):
+            m.record(False, replica="bad", now=100.0 + i * 0.4)
+        assert m.evaluate(now=108.0) == []
+
+    def test_sustained_burn_alerts_once_with_offender(self):
+        m = self._mon()
+        for i in range(300):
+            # "bad" contributes every error; "good" only successes
+            bad = i % 2 == 0
+            m.record(not bad, replica="bad" if bad else "good",
+                     latency_s=None if bad else 0.01,
+                     now=60.0 + i * 0.2)
+        alerts = m.evaluate(now=120.0)
+        assert len(alerts) == 1
+        a = alerts[0]
+        assert a["alert"] == "availability_burn"
+        assert a["replica"] == "bad"
+        assert a["burn_short"] > 2.0 and a["burn_long"] > 2.0
+        # rising edge: re-evaluating while still burning counts ONCE
+        m.evaluate(now=120.5)
+        m.evaluate(now=121.0)
+        assert m.alerts_total == 1
+        reg_snapshot = m._reg.snapshot()
+        assert agg.family_total(reg_snapshot["counters"],
+                                "fleet.alerts") == 1
+        # clearing and re-breaching is a NEW activation
+        for i in range(300):
+            m.record(True, now=121.0 + i * 0.05)
+        assert m.evaluate(now=136.0) == []
+        for i in range(300):
+            m.record(i % 2 == 0, replica="bad", now=140.0 + i * 0.1)
+        assert m.evaluate(now=170.0)
+        assert m.alerts_total == 2
+
+    def test_p99_slo_alert_names_slow_replica(self):
+        m = self._mon(p99_slo_ms=20.0)
+        for i in range(200):
+            slow = i % 2 == 0
+            m.record(True, latency_s=0.2 if slow else 0.001,
+                     replica="slow" if slow else "fast",
+                     now=60.0 + i * 0.2)
+        alerts = m.evaluate(now=100.0)
+        kinds = {a["alert"] for a in alerts}
+        assert "p99_slo" in kinds
+        p99a = next(a for a in alerts if a["alert"] == "p99_slo")
+        assert p99a["replica"] == "slow"
+        assert p99a["p99_short_ms"] > 20.0
+
+    def test_state_view(self):
+        m = self._mon(p99_slo_ms=50.0)
+        for i in range(50):
+            m.record(True, latency_s=0.01, now=100.0 + i * 0.1)
+        st = m.state(now=105.0)
+        assert st["alerts_total"] == 0
+        w = st["windows"][0]
+        assert w["decisions"] == 50
+        assert w["availability"] == 1.0
+        assert w["p99_ms"] is not None
+
+    def test_offending_replica_majority(self):
+        assert agg.offending_replica([
+            {"alert": "a", "replica": "x"},
+            {"alert": "b", "replica": "x"},
+            {"alert": "c", "replica": "y"},
+        ]) == "x"
+        assert agg.offending_replica([{"alert": "a",
+                                       "replica": None}]) is None
+
+
+# ==================================================== bounded dump dir
+class TestBoundedBundleDir:
+    def test_rate_limit_and_rotation_one_implementation(self, tmp_path):
+        """The shared discipline (ISSUE 17 satellite): a trigger
+        storm writes ONE bundle per interval; the dir never holds
+        more than max_bundles, oldest pruned first; names carry
+        prefix + zero-padded seq + reason."""
+        d = fr.BoundedBundleDir(str(tmp_path), prefix="incident-",
+                                max_bundles=3, min_interval_s=3600.0)
+        seq = d.try_begin()
+        assert seq == 1
+        for _ in range(10):  # storm: rate limit holds
+            assert d.try_begin() is None
+        p = d.write(seq, "burn_rate", {"x": 1})
+        assert os.path.basename(p) == "incident-00001-burn_rate.json"
+        with open(p) as f:
+            assert json.load(f) == {"x": 1}
+
+        d2 = fr.BoundedBundleDir(str(tmp_path), prefix="incident-",
+                                 max_bundles=3, min_interval_s=0.0)
+        for _ in range(6):
+            s = d2.try_begin()
+            d2.write(s, "r", {})
+        files = sorted(f for f in os.listdir(str(tmp_path))
+                       if f.startswith("incident-"))
+        assert len(files) == 3
+        assert files[-1].startswith("incident-00006")
+
+    def test_in_memory_mode(self):
+        d = fr.BoundedBundleDir(None, prefix="x-")
+        seq = d.try_begin()
+        assert d.path_for(seq, "r") is None
+        assert d.write(seq, "r", {"y": 2}) is None
+
+    def test_flight_recorder_delegates(self, tmp_path):
+        """FlightRecorder's dump discipline IS the shared dir (no
+        second copy): its knobs read through to BoundedBundleDir and
+        a foreign prefix in the same dir is not pruned."""
+        reg = om.MetricsRegistry()
+        rec = fr.FlightRecorder(dump_dir=str(tmp_path), capacity=8,
+                                min_interval_s=0.0, max_bundles=2,
+                                registry=reg)
+        assert isinstance(rec._dir, fr.BoundedBundleDir)
+        assert rec.min_interval_s == 0.0 and rec.max_bundles == 2
+        other = tmp_path / "incident-00001-x.json"
+        other.write_text("{}")
+        for i in range(4):
+            rec.record({"kind": "note", "i": i})
+            assert rec.maybe_dump("t") is not None
+        flights = [f for f in os.listdir(str(tmp_path))
+                   if f.startswith("flight-")]
+        assert len(flights) == 2
+        assert other.exists()  # prefix-scoped pruning
+
+
+# ==================================================== jax-free pins
+class TestJaxFreeImports:
+    def _run_blocked(self, tmp_path, code):
+        blocker = str(tmp_path / "jax.py")
+        with open(blocker, "w") as f:
+            f.write("raise ImportError('jax blocked for this test')\n")
+        env = dict(os.environ,
+                   PYTHONPATH=str(tmp_path) + os.pathsep + REPO
+                   + os.pathsep + os.path.join(REPO, "tools"))
+        return subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True,
+                              timeout=120)
+
+    def test_aggregate_imports_without_jax(self, tmp_path):
+        r = self._run_blocked(tmp_path, (
+            "from paddle_tpu.obs import aggregate\n"
+            "m = aggregate.merge_snapshots({'a': {'counters':"
+            " {'x': 1.0}}})\n"
+            "assert m['counters']['x'] == 1.0\n"
+            "print('OK')\n"
+        ))
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout
+
+    def test_fleet_view_imports_without_jax(self, tmp_path):
+        r = self._run_blocked(tmp_path, (
+            "import fleet_view\n"
+            "assert fleet_view.INCIDENT_SCHEMA"
+            " == 'paddle-tpu-fleet-incident/v1'\n"
+            "print('OK')\n"
+        ))
+        assert r.returncode == 0, r.stderr
+        assert "OK" in r.stdout

@@ -51,8 +51,11 @@ both run by `tests/test_check_bench_record.py`:
   and kill scope) and the coldstart row's raw
   `cache_boot_s`/`compile_boot_s` pair.
 - **bundle schema** (`bundle` subcommand): static lint of
-  flight-recorder bundles (obs/flight_recorder.py) — schema tag,
-  required top-level fields, well-formed span events.
+  flight-recorder bundles (obs/flight_recorder.py) AND fleet
+  incident bundles (serving/fleet.py FleetMonitor, ISSUE 17) —
+  schema tag, required top-level fields, well-formed span events
+  (for an incident bundle: across the stitched router + replica
+  rings), alert list shape.
 
 The enforced row lists (REQUIRED_MC_ROWS / AB_ROWS / TIMELINE_ROWS)
 live in `paddle_tpu/analysis/rows.py` — ONE source of truth consumed
@@ -89,7 +92,10 @@ if _REPO not in sys.path:
 from paddle_tpu.analysis.rows import (  # noqa: E402
     AB_ROWS,
     COLDSTART_FIELDS,
+    FLEET_AGG_FIELDS,
     FLEET_KILL_FIELDS,
+    FLEET_P99_ABS_TOL_MS,
+    FLEET_P99_RATIO_TOL,
     REQUIRED_MC_ROWS,
     REQUIRED_SERVE_ROWS,
     TIMELINE_FIELDS,
@@ -112,11 +118,20 @@ SPAN_SPLIT_TOL = 0.15
 # paddle_tpu/obs/ modules the obs lint additionally REQUIRES to exist
 REQUIRED_OBS_MODULES = (
     "metrics.py", "timeline.py", "tracing.py", "flight_recorder.py",
+    "aggregate.py",
 )
 
 BUNDLE_SCHEMA = "paddle-tpu-flight-bundle/v1"
 BUNDLE_REQUIRED_FIELDS = (
     "schema", "reason", "ts", "pid", "seq", "events", "metrics",
+)
+# fleet incident bundles (ISSUE 17): the router's cross-process
+# stitch — alerts + per-replica flightz rings + the merged fleet view
+# ride beside the router's own event ring
+INCIDENT_SCHEMA = "paddle-tpu-fleet-incident/v1"
+INCIDENT_REQUIRED_FIELDS = (
+    "schema", "reason", "ts", "pid", "seq", "alerts", "events",
+    "replicas", "fleet",
 )
 SPAN_EVENT_FIELDS = (
     "name", "trace_id", "span_id", "parent_id", "ts", "dur_s",
@@ -450,6 +465,40 @@ def _check_fleet_row(row: dict) -> list:
             "row 'serve_fleet_loadtest': missing 'admitted_lost' — "
             "the zero-loss invariant must be recorded, not implied"
         )
+    # fleet-aggregated observability fields (ISSUE 17): the row must
+    # carry the merged-histogram fleet p99, the router's own p99 of
+    # the same requests, and the alert/scrape-failure accounting
+    missing = [f for f in FLEET_AGG_FIELDS if f not in row]
+    if missing:
+        violations.append(
+            f"row 'serve_fleet_loadtest': missing fleet-aggregated "
+            f"field(s) {missing} — the merged-histogram view and its "
+            f"router-side cross-check must both be recorded"
+        )
+        return violations
+    fleet_p99 = row["fleet_p99_ms"]
+    router_p99 = row["router_p99_ms"]
+    if not (isinstance(fleet_p99, (int, float)) and fleet_p99 > 0):
+        violations.append(
+            f"row 'serve_fleet_loadtest': fleet_p99_ms="
+            f"{fleet_p99!r} — the merged-bucket quantile must be a "
+            f"positive number (empty merge means the scrape chain "
+            f"is broken)"
+        )
+        return violations
+    if isinstance(router_p99, (int, float)) and router_p99 > 0:
+        ratio = max(fleet_p99, router_p99) / min(fleet_p99,
+                                                 router_p99)
+        if ratio > FLEET_P99_RATIO_TOL and \
+                abs(fleet_p99 - router_p99) > FLEET_P99_ABS_TOL_MS:
+            violations.append(
+                f"row 'serve_fleet_loadtest': fleet_p99_ms="
+                f"{fleet_p99:.3f} vs router_p99_ms={router_p99:.3f} "
+                f"disagree beyond {FLEET_P99_RATIO_TOL}x and "
+                f"{FLEET_P99_ABS_TOL_MS}ms — the replica-histogram "
+                f"merge and the router's own timing measure the same "
+                f"requests; one of the pipes is broken"
+            )
     return violations
 
 
@@ -467,7 +516,11 @@ def _check_coldstart_row(row: dict) -> list:
 
 
 def check_bundle(path: str) -> list:
-    """Static schema lint for one flight-recorder bundle file."""
+    """Static schema lint for one bundle file — flight-recorder
+    bundles AND fleet incident bundles (ISSUE 17), dispatched on the
+    schema tag. For an incident bundle the span-event check runs over
+    the STITCHED event set: the router's own ring plus every
+    replica's flightz ring."""
     violations = []
     try:
         with open(path) as f:
@@ -476,6 +529,8 @@ def check_bundle(path: str) -> list:
         return [f"{path}: unreadable bundle ({e})"]
     if not isinstance(doc, dict):
         return [f"{path}: bundle is not a JSON object"]
+    if doc.get("schema") == INCIDENT_SCHEMA:
+        return _check_incident_bundle(path, doc)
     if doc.get("schema") != BUNDLE_SCHEMA:
         violations.append(
             f"{path}: schema {doc.get('schema')!r} != "
@@ -484,34 +539,76 @@ def check_bundle(path: str) -> list:
     for field in BUNDLE_REQUIRED_FIELDS:
         if field not in doc:
             violations.append(f"{path}: missing field {field!r}")
-    events = doc.get("events")
-    if not isinstance(events, list):
-        violations.append(f"{path}: 'events' is not a list")
-        events = []
-    for i, ev in enumerate(events):
-        if not isinstance(ev, dict) or "kind" not in ev:
-            violations.append(
-                f"{path}: events[{i}] has no 'kind'"
-            )
-            continue
-        if ev["kind"] == "span":
-            missing = [f for f in SPAN_EVENT_FIELDS if f not in ev]
-            if missing:
-                violations.append(
-                    f"{path}: events[{i}] span missing {missing}"
-                )
-            elif not (isinstance(ev["dur_s"], (int, float))
-                      and ev["dur_s"] >= 0):
-                violations.append(
-                    f"{path}: events[{i}] span dur_s "
-                    f"{ev['dur_s']!r} is not a non-negative number"
-                )
+    violations.extend(_check_events(path, "events", doc.get("events")))
     prof = doc.get("profile")
     if prof is not None and (not isinstance(prof, dict)
                              or "captured" not in prof):
         violations.append(
             f"{path}: 'profile' stanza malformed (needs 'captured')"
         )
+    return violations
+
+
+def _check_events(path: str, where: str, events) -> list:
+    violations = []
+    if not isinstance(events, list):
+        return [f"{path}: '{where}' is not a list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or "kind" not in ev:
+            violations.append(
+                f"{path}: {where}[{i}] has no 'kind'"
+            )
+            continue
+        if ev["kind"] == "span":
+            missing = [f for f in SPAN_EVENT_FIELDS if f not in ev]
+            if missing:
+                violations.append(
+                    f"{path}: {where}[{i}] span missing {missing}"
+                )
+            elif not (isinstance(ev["dur_s"], (int, float))
+                      and ev["dur_s"] >= 0):
+                violations.append(
+                    f"{path}: {where}[{i}] span dur_s "
+                    f"{ev['dur_s']!r} is not a non-negative number"
+                )
+    return violations
+
+
+def _check_incident_bundle(path: str, doc: dict) -> list:
+    violations = []
+    for field in INCIDENT_REQUIRED_FIELDS:
+        if field not in doc:
+            violations.append(f"{path}: missing field {field!r}")
+    alerts = doc.get("alerts")
+    if not isinstance(alerts, list):
+        violations.append(f"{path}: 'alerts' is not a list")
+    else:
+        for i, a in enumerate(alerts):
+            if not isinstance(a, dict) or "alert" not in a:
+                violations.append(
+                    f"{path}: alerts[{i}] has no 'alert' kind"
+                )
+    fleet = doc.get("fleet")
+    if fleet is not None and (not isinstance(fleet, dict)
+                              or "merged" not in fleet):
+        violations.append(
+            f"{path}: 'fleet' stanza malformed (needs 'merged')"
+        )
+    violations.extend(_check_events(path, "events", doc.get("events")))
+    replicas = doc.get("replicas")
+    if not isinstance(replicas, dict):
+        violations.append(f"{path}: 'replicas' is not a dict")
+        replicas = {}
+    for name, ring in replicas.items():
+        if not isinstance(ring, dict):
+            violations.append(
+                f"{path}: replicas[{name!r}] is not a dict"
+            )
+            continue
+        if "events" in ring:
+            violations.extend(_check_events(
+                path, f"replicas[{name!r}].events", ring["events"]
+            ))
     return violations
 
 

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Render fleet incident bundles (ISSUE 17).
+
+The read side of the fleet observability plane: given a
+`paddle-tpu-fleet-incident/v1` JSON bundle (written by the router's
+FleetMonitor when a burn-rate alert fires), this tool
+
+- prints the incident header: reason, active alerts with their burn
+  rates / p99s, the offending replica the alerts implicate, and the
+  router's per-replica state table at trigger time;
+- summarizes the merged fleet view (admitted/shed counter deltas,
+  fleet p50/p99 from the merged le-buckets) from the bundle's scrape
+  history;
+- stitches the span events — the router's own flight ring PLUS every
+  replica's `flightz` ring dump — and reuses `trace_view`'s
+  grouping/critical-path machinery on the combined set, marking each
+  trace with the set of processes it crossed. A cross-process trace
+  is one whose spans came from more than one ring (router + replica,
+  or two replicas), i.e. the request path the incident interrupted.
+
+Pure stdlib + sibling `trace_view` (same portability contract: copy
+the two files to any box and they run — no jax, no package install).
+
+Usage:
+    python tools/fleet_view.py BUNDLE [--top N] [--trace ID] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import trace_view  # noqa: E402  (sibling import, kept standalone)
+
+INCIDENT_SCHEMA = "paddle-tpu-fleet-incident/v1"
+
+
+def load_bundle(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("schema") != INCIDENT_SCHEMA:
+        raise SystemExit(
+            f"{path}: not a fleet incident bundle "
+            f"(schema={doc.get('schema') if isinstance(doc, dict) else '?'!r};"
+            f" expected {INCIDENT_SCHEMA})"
+        )
+    return doc
+
+
+def stitched_spans(doc: dict) -> list:
+    """All span events in the bundle, each annotated with the process
+    it came from: `"router"` for the router's own ring, the replica
+    name for a flightz ring. The annotation (`_origin`) is what makes
+    "this trace crossed N processes" checkable after stitching."""
+    spans = []
+    for e in doc.get("events", []):
+        if isinstance(e, dict) and e.get("kind") == "span":
+            spans.append(dict(e, _origin="router"))
+    for name, ring in (doc.get("replicas") or {}).items():
+        if not isinstance(ring, dict):
+            continue
+        for e in ring.get("events", []):
+            if isinstance(e, dict) and e.get("kind") == "span":
+                spans.append(dict(e, _origin=name))
+    return spans
+
+
+def analyze(path: str, top: int = 10, trace_id: str = None) -> dict:
+    doc = load_bundle(path)
+    spans = stitched_spans(doc)
+    traces = trace_view.group_traces(spans)
+    if trace_id is not None:
+        matches = [t for t in traces if t.startswith(trace_id)]
+        if not matches:
+            raise SystemExit(f"trace {trace_id!r} not found in "
+                             f"{len(traces)} traces")
+        traces = {t: traces[t] for t in matches}
+    analyzed = []
+    for group in traces.values():
+        a = trace_view.critical_path(group)
+        a["processes"] = sorted({s.get("_origin", "?") for s in group})
+        a["cross_process"] = len(a["processes"]) > 1
+        analyzed.append(a)
+    # cross-process traces first (they are what an incident is about),
+    # then by duration
+    analyzed.sort(key=lambda a: (not a["cross_process"], -a["dur_ms"]))
+    fleet = doc.get("fleet") or {}
+    delta = fleet.get("delta") or {}
+    merged = fleet.get("merged") or {}
+    return {
+        "bundle": path,
+        "schema": doc.get("schema"),
+        "reason": doc.get("reason"),
+        "ts": doc.get("ts"),
+        "alerts": doc.get("alerts", []),
+        "offending": doc.get("offending"),
+        "states": doc.get("states", {}),
+        "replica_rings": {
+            name: {
+                "enabled": bool(ring.get("enabled", False)),
+                "events": len(ring.get("events", [])),
+            } if isinstance(ring, dict) else {"enabled": False,
+                                              "events": 0}
+            for name, ring in (doc.get("replicas") or {}).items()
+        },
+        "fleet_quantiles": _fleet_quantiles(delta or merged),
+        "span_count": len(spans),
+        "trace_count": len(traces),
+        "traces": analyzed[: max(top, 1)],
+    }
+
+
+def _fleet_quantiles(snapshot: dict) -> dict:
+    """p50/p99 per merged admitted-latency series (one per model)."""
+    out = {}
+    for name, h in (snapshot.get("histograms") or {}).items():
+        if not name.split("{", 1)[0].endswith("admitted_latency_s"):
+            continue
+        out[name] = {}
+        for q, key in ((0.50, "p50_ms"), (0.99, "p99_ms")):
+            v = _quantile(h, q)
+            out[name][key] = round(v * 1e3, 3) if v is not None \
+                else None
+    return out
+
+
+def _quantile(h: dict, q: float):
+    # the upper-bound bucket-walk estimate, duplicated from
+    # paddle_tpu/obs/aggregate.py::quantile — this file must stay
+    # standalone-stdlib (copyable next to trace_view.py without the
+    # package); change both together
+    buckets = h.get("buckets")
+    bounds = h.get("bounds")
+    if not buckets or bounds is None:
+        return None
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    rank = max(int(math.ceil(q * total)), 1)
+    cum = 0
+    for i, n in enumerate(buckets):
+        cum += n
+        if cum >= rank:
+            if i < len(bounds):
+                return float(bounds[i])
+            break
+    mx = h.get("max")
+    return float(mx) if mx is not None else float(bounds[-1])
+
+
+def render(report: dict) -> str:
+    lines = [
+        f"fleet incident {report['bundle']}",
+        f"  reason={report['reason']}  ts={report['ts']}  "
+        f"offending={report['offending'] or '?'}",
+    ]
+    for a in report["alerts"]:
+        lines.append("  alert: " + json.dumps(a, sort_keys=True))
+    if report["states"]:
+        lines.append("  replica states at trigger:")
+        for name, st in sorted(report["states"].items()):
+            lines.append(
+                f"    {name:12s} breaker={st.get('breaker'):9s} "
+                f"queue={st.get('queue_depth')} "
+                f"inflight={st.get('inflight')} "
+                f"stale={st.get('stale')} "
+                f"scrape_failures={st.get('scrape_failures')}"
+            )
+    if report["fleet_quantiles"]:
+        lines.append("  fleet latency (merged buckets, last delta):")
+        for name, qs in sorted(report["fleet_quantiles"].items()):
+            lines.append(f"    {name}: p50={qs['p50_ms']} ms "
+                         f"p99={qs['p99_ms']} ms")
+    rings = report["replica_rings"]
+    ring_txt = ", ".join(
+        f"{n}={r['events']}ev" + ("" if r["enabled"] else " (off)")
+        for n, r in sorted(rings.items())
+    )
+    lines.append(f"  rings: router + {ring_txt}")
+    lines.append(
+        f"  {report['span_count']} stitched spans / "
+        f"{report['trace_count']} traces; top {len(report['traces'])}:"
+    )
+    for t in report["traces"]:
+        procs = "+".join(t["processes"])
+        tag = "cross-process " if t["cross_process"] else ""
+        lines.append(
+            f"  trace {t['trace_id'][:16]:16s} root={t['root']:<20s} "
+            f"{t['dur_ms']:10.3f} ms  {tag}[{procs}]  "
+            f"status={t['status']}"
+        )
+        for seg in t["critical_path"]:
+            lines.append(
+                f"      {seg['name']:32s} {seg['dur_ms']:10.3f} ms "
+                f"{100 * seg['frac']:6.1f}%"
+                + ("" if seg["status"] == "ok"
+                   else f"  [{seg['status']}]")
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("bundle", help="fleet incident bundle (JSON)")
+    ap.add_argument("--top", type=int, default=10)
+    ap.add_argument("--trace", default=None,
+                    help="show one trace (id prefix ok)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    report = analyze(args.bundle, top=args.top, trace_id=args.trace)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -35,11 +35,24 @@ import sys
 from collections import defaultdict
 
 BUNDLE_SCHEMA = "paddle-tpu-flight-bundle/v1"
+INCIDENT_SCHEMA = "paddle-tpu-fleet-incident/v1"
+
+
+def _incident_events(doc: dict) -> list:
+    """Stitch a fleet-incident bundle's events: the router's own ring
+    plus every replica's flightz ring dump — the cross-process span
+    set one trace_id ties back together."""
+    events = list(doc.get("events", []))
+    for ring in (doc.get("replicas") or {}).values():
+        if isinstance(ring, dict):
+            events.extend(ring.get("events", []))
+    return events
 
 
 def load_spans(path: str) -> list:
-    """Spans from a JSONL stream or a flight-recorder bundle; the
-    format is sniffed from content, not the filename."""
+    """Spans from a JSONL stream, a flight-recorder bundle, or a
+    fleet-incident bundle; the format is sniffed from content, not
+    the filename."""
     with open(path) as f:
         first = f.read(1)
         f.seek(0)
@@ -52,6 +65,8 @@ def load_spans(path: str) -> list:
             doc = None
         if isinstance(doc, dict) and doc.get("schema") == BUNDLE_SCHEMA:
             events = doc.get("events", [])
+        elif isinstance(doc, dict) and doc.get("schema") == INCIDENT_SCHEMA:
+            events = _incident_events(doc)
         elif isinstance(doc, dict):
             events = [doc]
         else:
