@@ -62,6 +62,7 @@ JAX_FREE_DIRS = (
     "paddle_tpu/serving",
     "paddle_tpu/data",
     "paddle_tpu/native",
+    "paddle_tpu/decoding",
 )
 JAX_FREE_FILES = (
     "paddle_tpu/__init__.py",

@@ -78,6 +78,23 @@ FLEET_AGG_FIELDS = (
 FLEET_P99_RATIO_TOL = 3.0
 FLEET_P99_ABS_TOL_MS = 30.0
 
+# decode dispatch-chain gate (ISSUE 18): the beam-decode north-star
+# row must carry a MEASURED chain-depth A/B — the K-token arm's
+# dispatch count (counted in the running program / host loop, never
+# derived from config), the K=1 baseline's count, and the interleaved
+# tokens/s ratio between them. The compare pass trips when the depth
+# stops shrinking or the speedup falls under the floor — chain depth
+# is the decode bottleneck the nmt_beam4_decode_b32 capture proved
+# (7.7x gap over the byte floor), so losing the reduction is a
+# regression of the row's whole point. An explicit
+# `chain_ab_skipped` reason is the only accepted absence, mirroring
+# AB_ROWS' ab_skipped discipline.
+DECODE_CHAIN_ROW = "nmt_beam4_decode_tokens_per_s"
+DECODE_CHAIN_FIELDS = (
+    "dispatch_chain_depth", "dispatch_chain_depth_k1", "chain_speedup",
+)
+DECODE_CHAIN_SPEEDUP_FLOOR = 1.5
+
 # north-star rows that must carry the timeline triple (ISSUE 10).
 # MUST equal bench.py's NORTH_STARS — check_bench_record's static
 # mode enforces the sync.

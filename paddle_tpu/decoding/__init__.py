@@ -1,0 +1,12 @@
+"""Decoding strategies beyond the plain beam-search program (ISSUE 18).
+
+This package is inside the jax-import fence (`analysis/ast_lint.py`
+JAX_FREE_DIRS): module scope stays importable with jax blocked so the
+serving/observability layers can reach the constructors cheaply;
+anything that traces or dispatches imports jax function-locally.
+"""
+
+from paddle_tpu.decoding.speculative import (  # noqa: F401
+    SpeculativeGreedyDecoder,
+    make_draft_decoder,
+)

@@ -1,0 +1,274 @@
+"""Speculative greedy decoding (ISSUE 18 tentpole, rung b).
+
+The committed `nmt_beam4_decode_b32` capture proved decode latency is
+dispatch-chain depth, not bytes or FLOPs (ROADMAP item 2). Multi-token
+dispatch (`BeamSearchDecoder.tokens_per_dispatch`) shortens the chain
+by scanning the SAME net K times per program; speculative decoding —
+Leviathan et al.'s draft-proposes / target-verifies scheme, greedy
+variant — shortens it with a CHEAPER net: a small draft model proposes
+K tokens autoregressively inside one compiled scan program, then the
+target model verifies all K positions in ONE batched forward (also a
+compiled scan — every position's input is already known, so the
+target's K steps carry no host round-trips between them). The host
+accepts the longest agreeing prefix plus the target's one corrected
+token, so every round emits >= 1 token for <= 2 dispatches: the chain
+shrinks from `max_len` to at most `2*ceil(max_len/accepted_per_round)`
+and the OUTPUT IS EXACTLY THE TARGET'S GREEDY OUTPUT, token for token,
+no matter how bad the draft is (a worthless draft only costs speed,
+never correctness — pinned by tests/test_decoding.py).
+
+Per-round bookkeeping is numpy on the host (the degradation-ladder
+discipline from serving/host_decode.py): rows advance at different
+rates, so each round gathers, per row, the stacked per-step memories
+matching that row's accepted prefix from the scan programs' outputs.
+
+Both nets are plain `BeamSearchDecoder`s with beam_size=1 — the draft
+constructor below builds one from the same DSL layer inventory the
+target uses. Chain depth is measured (dispatches counted), never
+derived: `last_chain_depth` after each generate(), same contract as
+the beam decoder.
+
+Caveat: a `logprob_fn` must be position-independent (ignore its `t`
+argument) to compose with speculative decoding — rows progress at
+per-row rates, while one scan program stamps a single base `t0`.
+
+Module scope is jax-free (this package sits in the ast_lint import
+fence); tracing imports jax function-locally.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def make_draft_decoder(step: Callable, n_static: int, bos_id: int,
+                       eos_id: int, max_length: int,
+                       logprob_fn: Optional[Callable] = None,
+                       static_sizes: Optional[list] = None):
+    """Build a draft model from the existing DSL layer inventory: the
+    same `step(word, *statics)` authoring contract as the target
+    decoder, forced to beam_size=1 (speculative verification is
+    greedy). Keep the draft's layer/param NAMES distinct from the
+    target's — the two nets carry separate param dicts."""
+    from paddle_tpu.beam_search import BeamSearchDecoder
+
+    return BeamSearchDecoder(
+        step, n_static, bos_id=bos_id, eos_id=eos_id, beam_size=1,
+        max_length=max_length, logprob_fn=logprob_fn,
+        static_sizes=static_sizes,
+    )
+
+
+class SpeculativeGreedyDecoder:
+    """Draft/target speculative wrapper around two beam_size=1
+    decoders.
+
+        spec = SpeculativeGreedyDecoder(target_dec, draft_dec,
+                                        propose_k=4)
+        seqs, lens, scores = spec.generate(params, draft_params,
+                                           statics=[...], boots={...})
+
+    Outputs match `target_dec.generate(...)` (greedy reference)
+    token-for-token; shapes are the decoder's [B, 1, max_length] /
+    [B, 1] contract so the serving batcher can swap it in unchanged.
+    """
+
+    def __init__(self, target, draft, propose_k: int = 4):
+        assert target.k == 1 and draft.k == 1, (
+            "speculative decoding verifies greedily: both target and "
+            f"draft need beam_size=1 (got {target.k}/{draft.k})"
+        )
+        assert propose_k >= 1
+        assert target.bos_id == draft.bos_id, "bos_id mismatch"
+        assert target.eos_id == draft.eos_id, "eos_id mismatch"
+        self.target, self.draft = target, draft
+        self.propose_k = int(propose_k)
+        # measured diagnostics of the last generate(): sequential
+        # dispatches issued, and the proposal accept rate
+        self.last_chain_depth: Optional[int] = None
+        self.last_steps: Optional[int] = None
+        self.last_accept_rate: Optional[float] = None
+        self._progs = {}
+        self._recompile_guard = None
+
+    def _guard(self):
+        if self._recompile_guard is None:
+            from paddle_tpu.analysis.recompile_guard import (
+                RecompileGuard,
+            )
+
+            self._recompile_guard = RecompileGuard("spec_decode")
+        return self._recompile_guard
+
+    def _scan_program(self, role: str, dec, b: int, n: int,
+                      self_feed: bool):
+        """N decode steps of `dec`'s step net as ONE jitted scan
+        program: (params, static_feed, mems, first_word [B], words
+        [N,B], t0) -> (greedy [N,B], greedy_logp [N,B], mems_stack
+        {name: [N,B,size]}).
+
+        self_feed=True (draft propose): each step consumes the
+        previous step's own argmax, starting from first_word — the
+        K-token autoregressive proposal in one dispatch. False (target
+        verify): step j consumes words[j] — all inputs known up front,
+        the 'verify K positions in one batched forward'."""
+        key = (role, b, n, self_feed, dec.logprob_fn, dec.eos_id)
+        if key not in self._progs and len(self._progs) >= 16:
+            self._progs.pop(next(iter(self._progs)))
+        if key not in self._progs:
+            import jax
+            import jax.numpy as jnp
+
+            from paddle_tpu.core.arg import Arg
+
+            net, memories, out_name = dec._net, dec.memories, \
+                dec.out_name
+            lpf = dec.logprob_fn
+            guard = self._guard()
+
+            def prog(params, static_feed, mems, first_word, words, t0):
+                guard.note(static_feed, mems, b=b, n=n, role=role)
+
+                def substep(carry, inp):
+                    mems, word = carry
+                    j, w_in = inp
+                    w = word if self_feed else w_in
+                    feed = dict(static_feed)
+                    feed["@word"] = Arg(ids=w)
+                    for m in memories:
+                        feed[m["link"]] = Arg(value=mems[m["layer"]])
+                    outs, _ = net.forward(params, feed, train=False)
+                    prob = outs[out_name].value  # [B, V]
+                    # f32 score math regardless of AMP, matching the
+                    # target decoder's pinned accumulator dtype
+                    logp = jnp.log(jnp.maximum(prob, 1e-20))
+                    logp = logp.reshape(b, 1, -1).astype(jnp.float32)
+                    if lpf is not None:
+                        logp = lpf(logp, t0 + j)
+                    logp = logp[:, 0, :]
+                    # argmax picks the first max — the same
+                    # lower-index tie-break as lax.top_k(k=1)
+                    g = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+                    glp = jnp.max(logp, axis=-1)
+                    new_mems = {
+                        m["layer"]: outs[m["layer"]].value
+                        for m in memories
+                    }
+                    return (new_mems, g), (g, glp, new_mems)
+
+                (_, _), (gs, glps, mstack) = jax.lax.scan(
+                    substep, (mems, first_word),
+                    (jnp.arange(n), words),
+                )
+                return gs, glps, mstack
+
+            self._progs[key] = jax.jit(prog)
+        return self._progs[key]
+
+    @property
+    def recompile_guards(self):
+        return [self._guard()]
+
+    def generate(self, params: dict, draft_params: dict,
+                 statics: list = None, boots: dict = None,
+                 batch_size: int = None, draft_statics: list = None,
+                 draft_boots: dict = None):
+        """Greedy-decode with draft/target speculation. `params` /
+        `statics` / `boots` condition the target exactly like
+        `target.generate`; the draft gets its own param dict and
+        (optionally) its own conditioning. Returns (seqs [B, 1,
+        max_length] int32, lens [B, 1] int32, scores [B, 1] float32) —
+        token-for-token the target's greedy output."""
+        import jax.numpy as jnp
+
+        tgt, drf, kp = self.target, self.draft, self.propose_k
+        t_max, eos, bos = tgt.max_length, tgt.eos_id, tgt.bos_id
+        t_feed, t_mems, b = tgt.prepare(statics or [], boots,
+                                        batch_size)
+        d_feed, d_mems, _ = drf.prepare(draft_statics or [],
+                                        draft_boots, batch_size=b)
+
+        seqs = np.full((b, 1, t_max), eos, np.int32)
+        scores = np.zeros((b,), np.float32)
+        last = np.full((b,), bos, np.int32)
+        pos = np.zeros((b,), np.int64)
+        finished = np.zeros((b,), bool)
+        rows = np.arange(b)
+        dispatches = proposed = accepted = 0
+
+        while not finished.all():
+            base = int(pos[~finished].min())
+            n = min(kp, t_max - base)
+            t0 = jnp.int32(base)
+            # 1 dispatch: draft proposes n tokens autoregressively
+            propose = self._scan_program("draft", drf, b, n, True)
+            props, _, d_stack = propose(
+                draft_params, d_feed, d_mems, jnp.asarray(last),
+                jnp.zeros((n, b), jnp.int32), t0,
+            )
+            dispatches += 1
+            props_np = np.asarray(props)  # [n, B]
+            # 1 dispatch: target verifies all n positions at once —
+            # position j consumes [last, props[:-1]][j]
+            vwords = np.concatenate([last[None, :], props_np[:-1]], 0)
+            verify = self._scan_program("target", tgt, b, n, False)
+            gs, glps, t_stack = verify(
+                params, t_feed, t_mems, jnp.asarray(last),
+                jnp.asarray(vwords), t0,
+            )
+            dispatches += 1
+            gs = np.asarray(gs)  # [n, B] the target's greedy tokens
+            glps = np.asarray(glps)
+
+            # host accept: longest agreeing prefix + the target's one
+            # corrected token. Since agreed positions have g == p, the
+            # accepted tokens are exactly gs[:n_acc] — the target's
+            # own greedy continuation.
+            agree = gs == props_np
+            live = ~finished
+            proposed += n * int(live.sum())
+            roll_idx = np.zeros((b,), np.int64)
+            for r in rows[live]:
+                mism = np.nonzero(~agree[:, r])[0]
+                n_acc = int(mism[0]) + 1 if mism.size else n
+                roll_idx[r] = n_acc - 1
+                n_app = min(n_acc, t_max - int(pos[r]))
+                toks = gs[:n_app, r]
+                hit = np.nonzero(toks == eos)[0]
+                if hit.size:
+                    n_app = int(hit[0]) + 1
+                    toks = toks[:n_app]
+                    finished[r] = True
+                seqs[r, 0, pos[r]:pos[r] + n_app] = toks
+                scores[r] += glps[:n_app, r].sum()
+                pos[r] += n_app
+                accepted += n_app
+                if pos[r] >= t_max:
+                    finished[r] = True
+                last[r] = toks[-1]
+            # roll both nets' states to the per-row accepted prefix:
+            # stack index i holds the state after consuming
+            # [last, props[:i]] — identical feeds on both nets, so the
+            # same index applies to each
+            t_mems = {
+                name: jnp.asarray(np.asarray(st)[roll_idx, rows])
+                for name, st in t_stack.items()
+            }
+            d_mems = {
+                name: jnp.asarray(np.asarray(st)[roll_idx, rows])
+                for name, st in d_stack.items()
+            }
+
+        self.last_chain_depth = dispatches
+        self.last_steps = int(pos.max())
+        self.last_accept_rate = (
+            accepted / proposed if proposed else None
+        )
+
+        is_eos = seqs == eos
+        any_eos = np.any(is_eos, axis=-1)
+        first_eos = np.argmax(is_eos, axis=-1)
+        lens = np.where(any_eos, first_eos + 1, t_max).astype(np.int32)
+        return seqs, lens, scores[:, None].copy()

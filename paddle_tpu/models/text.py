@@ -236,14 +236,18 @@ def seq2seq_attention_decoder(
     eos_id=1,
     beam_size=4,
     max_length=50,
+    tokens_per_dispatch=1,
 ):
     """Generation decoder sharing parameter names with
-    seq2seq_attention (use the trained params dict directly)."""
+    seq2seq_attention (use the trained params dict directly).
+    `tokens_per_dispatch=K` advances K steps per compiled dispatch
+    (ISSUE 18) — bit-identical output, chain depth ceil(max_length/K)."""
     from paddle_tpu.beam_search import BeamSearchDecoder
 
     step = _attention_decoder_step(hidden, trg_vocab, emb_dim)
     return BeamSearchDecoder(step, n_static=1, bos_id=bos_id, eos_id=eos_id,
-                             beam_size=beam_size, max_length=max_length)
+                             beam_size=beam_size, max_length=max_length,
+                             tokens_per_dispatch=tokens_per_dispatch)
 
 
 def hierarchical_lstm_classifier(

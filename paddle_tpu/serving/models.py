@@ -5,12 +5,18 @@ Two engine kinds compose the existing pieces:
 - `GenerationModel` — beam-search generation over a
   `BeamSearchDecoder`. Rung 1 is the decoder's own jitted while-loop
   program (bounded decode-program cache, `beam_search.py`); rung 2 is
-  the host-stepped per-token path (`host_decode.py`), taken whenever
-  generation hooks are present (pure_callback-free, so hook-bearing
-  requests stay servable on runtimes that reject host callbacks) or
-  when rung 1 fails and the server's `host_fallback` is on. An
-  optional `encode` callable turns the packed source ids into the
-  decoder's statics/boots (the seq2seq encoder forward).
+  the host-stepped path (`host_decode.py`) — per-token when hooks are
+  present (pure_callback-free, so hook-bearing requests stay servable
+  on runtimes that reject host callbacks), per-K-token-chunk when the
+  decoder carries `tokens_per_dispatch > 1` (ISSUE 18) — taken for
+  hook requests or when rung 1 fails and the server's `host_fallback`
+  is on. An optional speculative rung (`speculative=` a
+  SpeculativeGreedyDecoder + `draft_params`) serves hook-free greedy
+  requests draft-first, token-for-token equal to the target's greedy
+  output. An optional `encode` callable turns the packed source ids
+  into the decoder's statics/boots (the seq2seq encoder forward);
+  `tokens_per_dispatch` is part of the server's dispatch-key
+  accounting the same way len/batch buckets are.
 
 - `MultiForwardHost` — N forward-scoring submodels merged into ONE
   compiled program via `multi_network.merge_confs`, each submodel's
@@ -41,11 +47,30 @@ class GenerationModel:
     engine = None
 
     def __init__(self, decoder, params, encode: Optional[Callable] = None,
-                 named_hooks: Optional[Dict] = None):
+                 named_hooks: Optional[Dict] = None, speculative=None,
+                 draft_params=None, draft_encode: Optional[Callable] = None):
         self.decoder = decoder
         self.params = params
         self.encode = encode
         self.named_hooks = named_hooks or {}
+        self.speculative = speculative
+        self.draft_params = draft_params
+        self.draft_encode = draft_encode
+        if speculative is not None:
+            assert draft_params is not None, (
+                "speculative serving needs draft_params"
+            )
+            assert speculative.target is decoder, (
+                "speculative.target must be the served decoder — "
+                "anything else would serve a different model's tokens"
+            )
+
+    @property
+    def tokens_per_dispatch(self):
+        """K of the decode program's multi-token dispatch — part of
+        the server's dispatch-key accounting (a K change is a new
+        compiled-program family, exactly like a new len bucket)."""
+        return getattr(self.decoder, "tokens_per_dispatch", 1)
 
     @property
     def recompile_guards(self):
@@ -54,7 +79,10 @@ class GenerationModel:
         model's guards after warmup. Lazy: the guard exists once the
         first jitted decode program was built."""
         g = getattr(self.decoder, "_recompile_guard", None)
-        return (g,) if g is not None else ()
+        out = (g,) if g is not None else ()
+        if self.speculative is not None:
+            out = out + tuple(self.speculative.recompile_guards)
+        return out
 
     def run_batch(self, ids, lens, hooks, host: bool):
         from paddle_tpu.serving.host_decode import host_generate
@@ -72,6 +100,17 @@ class GenerationModel:
                 batch_size=bs, hooks=hooks,
             )
             path = "host"
+        elif self.speculative is not None:
+            if self.draft_encode is not None:
+                d_statics, d_boots = self.draft_encode(ids, lens)
+            else:
+                d_statics, d_boots = None, None
+            seqs, out_lens, scores = self.speculative.generate(
+                self.params, self.draft_params, statics=statics,
+                boots=boots, batch_size=bs, draft_statics=d_statics,
+                draft_boots=d_boots,
+            )
+            path = "spec"
         else:
             seqs, out_lens, scores = dec.generate(
                 self.params, statics=statics, boots=boots, batch_size=bs

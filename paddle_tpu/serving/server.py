@@ -857,7 +857,8 @@ class InferenceServer:
                 )
                 en.dispatch_keys.add(
                     (reqs[0].bucket, self.config.batch_bucket(len(reqs)),
-                     reqs[0].hooks_key is not None)
+                     reqs[0].hooks_key is not None,
+                     getattr(en.model, "tokens_per_dispatch", 1))
                 )
                 rows = results[name]
                 lats = []

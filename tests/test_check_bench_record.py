@@ -418,3 +418,52 @@ def test_static_pins_fleet_rows(tmp_path):
     (work / "bench.py").write_text(src)
     v = cbr.check_static(str(work))
     assert any("serve_fleet_loadtest" in x for x in v)
+
+
+def test_compare_decode_chain_tripwire(tmp_path):
+    """ISSUE 18: a measured nmt_beam4 decode row must carry the
+    chain-depth A/B (measured K-arm depth, K=1 baseline depth, and
+    the interleaved tokens/s ratio) — and the compare pass trips when
+    the depth stops shrinking or the speedup falls under the floor.
+    `chain_ab_skipped` is the only accepted absence."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    base = {
+        "metric": "nmt_beam4_decode_tokens_per_s", "value": 1000.0,
+        # north-star row: satisfy the timeline triple so the chain
+        # checks are isolated
+        "data_wait_frac": 0.0, "host_overhead_frac": 0.1,
+        "device_frac": 0.9,
+    }
+    good = dict(base, dispatch_chain_depth=4,
+                dispatch_chain_depth_k1=32, chain_speedup=3.4)
+    assert lint(good) == []
+
+    # silently dropping the A/B fields is a violation
+    v = lint(base)
+    assert v and "chain" in v[0] and "chain_ab_skipped" in v[0]
+    # ... but an explicit skip reason is accepted
+    assert lint(dict(base, chain_ab_skipped="probe failed: X")) == []
+    # ... as is an errored row (nothing was measured)
+    assert lint({"metric": "nmt_beam4_decode_tokens_per_s",
+                 "value": None, "error": "RuntimeError: x"}) == []
+
+    # chain no longer shrinking: depth >= K=1 baseline
+    v = lint(dict(good, dispatch_chain_depth=32))
+    assert any("dispatch_chain_depth" in x for x in v)
+    v = lint(dict(good, dispatch_chain_depth=0))
+    assert any("dispatch_chain_depth" in x for x in v)
+
+    # speedup under the 1.5x floor
+    v = lint(dict(good, chain_speedup=1.2))
+    assert any("chain_speedup" in x and "floor" in x for x in v)
+
+    # non-numeric garbage (e.g. a stringified number) is caught
+    v = lint(dict(good, chain_speedup="3.4"))
+    assert any("non-numeric" in x for x in v)

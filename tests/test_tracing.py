@@ -376,6 +376,74 @@ class TestServeTraceEndToEnd:
         finally:
             server.shutdown(drain=True)
 
+    def test_decode_chunk_spans_under_multi_token_dispatch(
+        self, global_recorder
+    ):
+        """Under multi-token dispatch (ISSUE 18) the host rung's
+        per-token decode.token spans become per-CHUNK decode.chunk
+        spans carrying a `tokens` label, still parented under the
+        batch's dispatch span — so trace_view critical paths and the
+        serve-row span split keep reconciling: the decode rung's time
+        is covered by chunk spans instead of token spans, never
+        double-counted by both."""
+        from paddle_tpu import dsl
+        from paddle_tpu.beam_search import BeamSearchDecoder, BeamHooks
+        from paddle_tpu.core.config import ParameterConf
+        from paddle_tpu.serving.models import GenerationModel
+        from paddle_tpu.serving.server import (
+            InferenceServer,
+            ServeConfig,
+        )
+        import jax.numpy as jnp
+
+        vocab, max_len, k_tok = 16, 6, 4
+
+        def step(word):
+            emb = dsl.embedding(
+                word, size=vocab, vocab_size=vocab,
+                param=ParameterConf(name="trace_bigram_mt"),
+            )
+            return dsl.mixed(vocab, [(emb, "identity")],
+                             act="softmax", bias=False, name="prob")
+
+        dec = BeamSearchDecoder(step, n_static=0, bos_id=0, eos_id=1,
+                                beam_size=2, max_length=max_len,
+                                tokens_per_dispatch=k_tok)
+        rng = np.random.default_rng(0)
+        table = rng.standard_normal((vocab, vocab)).astype(np.float32)
+        table[:, 1] = -50.0  # no eos: full max_len walk, 2 chunks
+        params = {"trace_bigram_mt": jnp.asarray(table)}
+        model = GenerationModel(
+            dec, params,
+            # empty hooks force the host rung but carry no callbacks,
+            # so the chunked path is eligible
+            named_hooks={"noop": BeamHooks()},
+        )
+        server = InferenceServer(ServeConfig(max_queue=8, max_batch=1))
+        server.add_model("gen", model)
+        try:
+            req = server.submit(
+                "gen", [2, 3], deadline_s=120.0, hooks_name="noop",
+                trace={"trace_id": tracing.new_trace_id(),
+                       "span_id": ""},
+            )
+            out = req.result(timeout=120)
+            assert out["path"] == "host"
+            by = _wait_spans(global_recorder, "serve.dispatch")
+            chunks = by.get("decode.chunk", [])
+            assert by.get("decode.token", []) == []
+            # ceil(6/4) = 2 chunks covering all max_len tokens
+            assert len(chunks) == 2
+            assert sorted(c["labels"]["tokens"] for c in chunks) \
+                == [2, 4]
+            disp = by["serve.dispatch"][0]
+            assert all(c["parent_id"] == disp["span_id"]
+                       for c in chunks)
+            assert all(c["trace_id"] == disp["trace_id"]
+                       for c in chunks)
+        finally:
+            server.shutdown(drain=True)
+
 
 # ===================================== cross-process / fault coverage
 @pytest.mark.faults

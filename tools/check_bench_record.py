@@ -92,6 +92,9 @@ if _REPO not in sys.path:
 from paddle_tpu.analysis.rows import (  # noqa: E402
     AB_ROWS,
     COLDSTART_FIELDS,
+    DECODE_CHAIN_FIELDS,
+    DECODE_CHAIN_ROW,
+    DECODE_CHAIN_SPEEDUP_FLOOR,
     FLEET_AGG_FIELDS,
     FLEET_KILL_FIELDS,
     FLEET_P99_ABS_TOL_MS,
@@ -380,6 +383,12 @@ def check_compare(stdout_path: str, record_path: str) -> list:
         if m == "serve_coldstart" and "error" not in d \
                 and "skipped" not in d:
             violations.extend(_check_coldstart_row(d))
+        # decode-chain gate (ISSUE 18): the beam-decode row's
+        # measured dispatch_chain_depth / chain_speedup must be
+        # present, genuinely reduced, and above the floor
+        if m == DECODE_CHAIN_ROW and "error" not in d \
+                and "skipped" not in d:
+            violations.extend(_check_decode_chain_row(d))
         # A/B tripwire (ISSUE 12): a measured longctx/NMT-T128 row
         # without a flash A/B verdict means the dense-vs-flash
         # comparison silently dropped out of the record
@@ -390,6 +399,52 @@ def check_compare(stdout_path: str, record_path: str) -> list:
                 f"explicit 'ab_skipped' reason — the interleaved "
                 f"dense-vs-flash A/B must not silently drop"
             )
+    return violations
+
+
+def _check_decode_chain_row(row: dict) -> list:
+    """nmt_beam4 decode rows (ISSUE 18): the chain-depth A/B is the
+    row's whole point — the committed capture proved decode is
+    dispatch-chain-bound (7.7x over the byte floor), so a measured
+    row must show the chain actually shrinking and paying off. An
+    explicit `chain_ab_skipped` reason (probe failure) is the only
+    accepted absence, mirroring AB_ROWS' ab_skipped."""
+    if "chain_ab_skipped" in row:
+        return []
+    missing = [f for f in DECODE_CHAIN_FIELDS if f not in row]
+    if missing:
+        return [
+            f"row {DECODE_CHAIN_ROW!r}: missing chain field(s) "
+            f"{missing} and no 'chain_ab_skipped' reason — the "
+            f"measured dispatch-chain A/B must not silently drop"
+        ]
+    violations = []
+    depth = row["dispatch_chain_depth"]
+    base = row["dispatch_chain_depth_k1"]
+    speedup = row["chain_speedup"]
+    ok_num = all(
+        isinstance(x, (int, float)) and not isinstance(x, bool)
+        for x in (depth, base, speedup)
+    )
+    if not ok_num:
+        return [
+            f"row {DECODE_CHAIN_ROW!r}: non-numeric chain fields "
+            f"(depth={depth!r}, k1={base!r}, speedup={speedup!r})"
+        ]
+    if not (0 < depth < base):
+        violations.append(
+            f"row {DECODE_CHAIN_ROW!r}: dispatch_chain_depth={depth} "
+            f"vs k1 baseline {base} — the K-token arm no longer "
+            f"shortens the dispatch chain (depth must satisfy "
+            f"0 < depth < baseline, measured not assumed)"
+        )
+    if speedup < DECODE_CHAIN_SPEEDUP_FLOOR:
+        violations.append(
+            f"row {DECODE_CHAIN_ROW!r}: chain_speedup={speedup} under "
+            f"the {DECODE_CHAIN_SPEEDUP_FLOOR}x floor — the chain "
+            f"reduction stopped paying for itself (interleaved "
+            f"K-token vs K=1 tokens/s)"
+        )
     return violations
 
 
