@@ -95,6 +95,26 @@ DECODE_CHAIN_FIELDS = (
 )
 DECODE_CHAIN_SPEEDUP_FLOOR = 1.5
 
+# Transformer-LM north star (ISSUE 19): the LM-train row must carry a
+# measured-vs-analytic MFU (the `_nmt_train_flops_per_batch`
+# discipline — FLOPs derived from the model config, never from a
+# profiler), and the paged-decode row must carry the measured cache
+# story: `cache_hit_frac` (prefix tokens read from KV pages vs
+# recomputed by re-prefills), `prefix_recompute_bytes_saved` (those
+# cached reads priced at the per-token K/V recompute cost — bytes the
+# full-recompute baseline would have paid), and `cache_speedup` (the
+# interleaved paged-vs-recompute A/B ratio, floored below: if reading
+# the cache stops beating recomputing the prefix, the pool is
+# overhead, not an optimization). `cache_ab_skipped` is the only
+# accepted absence for the A/B fields, mirroring AB_ROWS.
+LM_TRAIN_ROW = "lm_train_tokens_per_s"
+LM_TRAIN_FIELDS = ("mfu",)
+LM_DECODE_ROW = "lm_decode_paged_tokens_per_s"
+LM_DECODE_FIELDS = (
+    "cache_hit_frac", "prefix_recompute_bytes_saved", "cache_speedup",
+)
+LM_CACHE_SPEEDUP_FLOOR = 1.1
+
 # north-star rows that must carry the timeline triple (ISSUE 10).
 # MUST equal bench.py's NORTH_STARS — check_bench_record's static
 # mode enforces the sync.
@@ -104,6 +124,8 @@ TIMELINE_ROWS = (
     "nmt_attention_train_tokens_per_s_bs512",
     "nmt_attention_train_tokens_per_s_t128",
     "nmt_beam4_decode_tokens_per_s",
+    "lm_train_tokens_per_s",
+    "lm_decode_paged_tokens_per_s",
     "serve_loadtest",
     "ctr_sparse_step_v_independence",
     "ctr_widedeep_sparse_v_independence",

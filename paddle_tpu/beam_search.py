@@ -38,6 +38,7 @@ rows report it measured-from-the-carried-counter, never assumed.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -227,15 +228,26 @@ class BeamSearchDecoder:
             statics, boots, batch_size
         )
         run = self._decode_program()
+        t0 = time.perf_counter()
         seqs, lens, scores, t_end, chunks = run(
             params, static_feed, init_carry_mem, b
         )
+        t1 = time.perf_counter()
         # the chain depth is MEASURED: `chunks` is a counter carried
         # through the while-loop state, incremented once per executed
         # iteration (= one sequential dispatch-chain link on a tunneled
-        # runtime), fetched after the run — never derived from config
+        # runtime), fetched after the run — never derived from config.
+        # The int() fetches BLOCK on the whole jitted while-loop, so
+        # they are the device-time window; only the submit window
+        # before them is host dispatch work (`last_timeline` is what
+        # bench rows must read — timing around generate() itself
+        # attributes the entire device run to dispatch and reports a
+        # nonsense host_overhead_frac of ~1.0)
         self.last_steps = int(t_end)
         self.last_chain_depth = int(chunks)
+        t2 = time.perf_counter()
+        self.last_timeline = {"dispatch_s": t1 - t0,
+                              "device_s": t2 - t1}
         return seqs, lens, scores
 
     def _decode_program(self):

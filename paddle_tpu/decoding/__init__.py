@@ -6,6 +6,12 @@ serving/observability layers can reach the constructors cheaply;
 anything that traces or dispatches imports jax function-locally.
 """
 
+from paddle_tpu.decoding.kv_cache import (  # noqa: F401
+    PagedKVCache,
+    PagedLM,
+    PoolExhausted,
+    SpeculativePagedLM,
+)
 from paddle_tpu.decoding.speculative import (  # noqa: F401
     SpeculativeGreedyDecoder,
     make_draft_decoder,

@@ -467,3 +467,115 @@ def test_compare_decode_chain_tripwire(tmp_path):
     # non-numeric garbage (e.g. a stringified number) is caught
     v = lint(dict(good, chain_speedup="3.4"))
     assert any("non-numeric" in x for x in v)
+
+
+def test_compare_lm_train_row(tmp_path):
+    """ISSUE 19: a measured lm_train row must carry its analytic MFU
+    as a sane fraction — the LM north star's whole point."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    good = {
+        "metric": "lm_train_tokens_per_s", "value": 4000.0,
+        "mfu": 0.31,
+        # north-star row: satisfy the timeline triple so the MFU
+        # checks are isolated
+        "data_wait_frac": 0.0, "host_overhead_frac": 0.1,
+        "device_frac": 0.9,
+    }
+    assert lint(good) == []
+    # seeded violation per field: mfu missing
+    bare = dict(good)
+    del bare["mfu"]
+    v = lint(bare)
+    assert v and "mfu" in v[0]
+    # ... not a fraction (analytic FLOPs over wall vs peak can't
+    # leave (0, 1])
+    for mfu in (0.0, 1.7, -0.2, "0.3", True):
+        v = lint(dict(good, mfu=mfu))
+        assert any("mfu" in x and "fraction" in x for x in v), mfu
+    # errored rows are exempt (nothing was measured)
+    assert lint({"metric": "lm_train_tokens_per_s", "value": None,
+                 "error": "RuntimeError: x"}) == []
+
+
+def test_compare_lm_decode_row(tmp_path):
+    """ISSUE 19: the paged-decode row's measured cache story —
+    hit fraction, bytes saved, speedup over recompute (floored), and
+    eviction-sweep points whose throughput actually scales with the
+    hit fraction. One seeded violation per required field."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    good = {
+        "metric": "lm_decode_paged_tokens_per_s", "value": 1500.0,
+        "cache_hit_frac": 1.0,
+        "prefix_recompute_bytes_saved": 154339328,
+        "cache_speedup": 8.9,
+        "points": [
+            {"evict_every": 0, "cache_hit_frac": 1.0, "tok_s": 1664.0},
+            {"evict_every": 4, "cache_hit_frac": 0.94, "tok_s": 1100.0},
+        ],
+        "data_wait_frac": 0.0, "host_overhead_frac": 0.99,
+        "device_frac": 0.01,
+    }
+    assert lint(good) == []
+    # seeded violation per required field: each one missing is caught
+    for field in ("cache_hit_frac", "prefix_recompute_bytes_saved",
+                  "cache_speedup"):
+        bare = dict(good)
+        del bare[field]
+        v = lint(bare)
+        assert any(field in x and "cache_ab_skipped" in x
+                   for x in v), field
+    # ... but an explicit skip reason is accepted
+    assert lint({"metric": "lm_decode_paged_tokens_per_s",
+                 "value": 1500.0,
+                 "cache_ab_skipped": "A/B failed: X",
+                 "data_wait_frac": 0.0, "host_overhead_frac": 0.99,
+                 "device_frac": 0.01}) == []
+    # hit fraction outside [0, 1]
+    v = lint(dict(good, cache_hit_frac=1.4))
+    assert any("cache_hit_frac" in x for x in v)
+    # zero bytes saved: the pool never did its job
+    v = lint(dict(good, prefix_recompute_bytes_saved=0))
+    assert any("prefix_recompute_bytes_saved" in x for x in v)
+    # speedup under the floor: cache stopped beating recompute
+    v = lint(dict(good, cache_speedup=1.01))
+    assert any("cache_speedup" in x and "floor" in x for x in v)
+    # throughput NOT scaling with cache hits across the sweep points
+    bad_pts = [
+        {"evict_every": 0, "cache_hit_frac": 1.0, "tok_s": 900.0},
+        {"evict_every": 4, "cache_hit_frac": 0.94, "tok_s": 1100.0},
+    ]
+    v = lint(dict(good, points=bad_pts))
+    assert any("scale" in x for x in v)
+    # errored rows are exempt
+    assert lint({"metric": "lm_decode_paged_tokens_per_s",
+                 "value": None, "error": "RuntimeError: x"}) == []
+
+
+def test_static_pins_lm_rows(tmp_path):
+    """Deleting an LM north-star row from bench.py is a regression
+    the static lint catches (ISSUE 19 satellite)."""
+    import shutil
+
+    work = tmp_path / "repo"
+    work.mkdir()
+    shutil.copy(os.path.join(REPO, "bench_multichip.py"),
+                work / "bench_multichip.py")
+    src = open(os.path.join(REPO, "bench.py")).read()
+    src = src.replace("lm_decode_paged_tokens_per_s", "lm_row_gone")
+    (work / "bench.py").write_text(src)
+    v = cbr.check_static(str(work))
+    assert any("lm_decode_paged_tokens_per_s" in x for x in v)

@@ -99,6 +99,11 @@ from paddle_tpu.analysis.rows import (  # noqa: E402
     FLEET_KILL_FIELDS,
     FLEET_P99_ABS_TOL_MS,
     FLEET_P99_RATIO_TOL,
+    LM_CACHE_SPEEDUP_FLOOR,
+    LM_DECODE_FIELDS,
+    LM_DECODE_ROW,
+    LM_TRAIN_FIELDS,
+    LM_TRAIN_ROW,
     REQUIRED_MC_ROWS,
     REQUIRED_SERVE_ROWS,
     TIMELINE_FIELDS,
@@ -216,6 +221,15 @@ def check_static(repo_dir: str) -> list:
             violations.append(
                 f"bench.py: permanent row {row!r} is no longer "
                 f"registered — the fleet robustness record would "
+                f"silently stop being captured"
+            )
+    # the Transformer-LM north stars (ISSUE 19) are permanent the
+    # same way: the MFU train row and the paged-decode cache row
+    for row in (LM_TRAIN_ROW, LM_DECODE_ROW):
+        if row not in bench_src:
+            violations.append(
+                f"bench.py: permanent row {row!r} is no longer "
+                f"registered — the LM north-star record would "
                 f"silently stop being captured"
             )
     # TIMELINE_ROWS here must equal bench.py's NORTH_STARS, else the
@@ -389,6 +403,14 @@ def check_compare(stdout_path: str, record_path: str) -> list:
         if m == DECODE_CHAIN_ROW and "error" not in d \
                 and "skipped" not in d:
             violations.extend(_check_decode_chain_row(d))
+        # LM north stars (ISSUE 19): analytic MFU on the train row;
+        # the measured cache story on the paged-decode row
+        if m == LM_TRAIN_ROW and "error" not in d \
+                and "skipped" not in d:
+            violations.extend(_check_lm_train_row(d))
+        if m == LM_DECODE_ROW and "error" not in d \
+                and "skipped" not in d:
+            violations.extend(_check_lm_decode_row(d))
         # A/B tripwire (ISSUE 12): a measured longctx/NMT-T128 row
         # without a flash A/B verdict means the dense-vs-flash
         # comparison silently dropped out of the record
@@ -445,6 +467,101 @@ def _check_decode_chain_row(row: dict) -> list:
             f"reduction stopped paying for itself (interleaved "
             f"K-token vs K=1 tokens/s)"
         )
+    return violations
+
+
+def _check_lm_train_row(row: dict) -> list:
+    """lm_train rows (ISSUE 19): MFU is the row's point — the
+    analytic FLOPs/step (model-config-derived, the
+    _nmt_train_flops_per_batch discipline) over the measured step
+    time against peak. It must be recorded as a sane fraction."""
+    missing = [f for f in LM_TRAIN_FIELDS if f not in row]
+    if missing:
+        return [
+            f"row {LM_TRAIN_ROW!r}: missing field(s) {missing} — the "
+            f"LM train north star must record its analytic MFU"
+        ]
+    mfu = row["mfu"]
+    if not (isinstance(mfu, (int, float))
+            and not isinstance(mfu, bool) and 0 < mfu <= 1.0):
+        return [
+            f"row {LM_TRAIN_ROW!r}: mfu={mfu!r} is not a fraction in "
+            f"(0, 1] — analytic FLOPs over measured wall against "
+            f"peak cannot leave that range"
+        ]
+    return []
+
+
+def _check_lm_decode_row(row: dict) -> list:
+    """lm_decode_paged rows (ISSUE 19): the measured cache story —
+    hit fraction, bytes the recompute baseline would have paid, and
+    the interleaved paged-vs-recompute speedup (floored: a KV pool
+    that stops beating full prefix recompute is overhead, not an
+    optimization). The row's eviction-sweep points must show decode
+    throughput actually SCALING with the hit fraction. An explicit
+    `cache_ab_skipped` reason is the only accepted absence for the
+    A/B-derived fields, mirroring AB_ROWS."""
+    if "cache_ab_skipped" in row:
+        return []
+    missing = [f for f in LM_DECODE_FIELDS if f not in row]
+    if missing:
+        return [
+            f"row {LM_DECODE_ROW!r}: missing cache field(s) "
+            f"{missing} and no 'cache_ab_skipped' reason — the "
+            f"measured cache story must not silently drop"
+        ]
+    violations = []
+    hit = row["cache_hit_frac"]
+    saved = row["prefix_recompute_bytes_saved"]
+    speedup = row["cache_speedup"]
+    if not (isinstance(hit, (int, float))
+            and not isinstance(hit, bool) and 0.0 <= hit <= 1.0):
+        violations.append(
+            f"row {LM_DECODE_ROW!r}: cache_hit_frac={hit!r} is not a "
+            f"fraction in [0, 1]"
+        )
+    if not (isinstance(saved, (int, float))
+            and not isinstance(saved, bool) and saved > 0):
+        violations.append(
+            f"row {LM_DECODE_ROW!r}: prefix_recompute_bytes_saved="
+            f"{saved!r} — a measured paged-decode run must have "
+            f"served cached prefix tokens (positive bytes), else the "
+            f"pool never did its job"
+        )
+    if not (isinstance(speedup, (int, float))
+            and not isinstance(speedup, bool)):
+        violations.append(
+            f"row {LM_DECODE_ROW!r}: cache_speedup={speedup!r} is "
+            f"not a number"
+        )
+    elif speedup < LM_CACHE_SPEEDUP_FLOOR:
+        violations.append(
+            f"row {LM_DECODE_ROW!r}: cache_speedup={speedup} under "
+            f"the {LM_CACHE_SPEEDUP_FLOOR}x floor — reading the KV "
+            f"pool stopped beating full prefix recompute "
+            f"(interleaved paged vs recompute tokens/s)"
+        )
+    pts = row.get("points")
+    if isinstance(pts, list):
+        scored = [
+            p for p in pts
+            if isinstance(p, dict)
+            and isinstance(p.get("cache_hit_frac"), (int, float))
+            and isinstance(p.get("tok_s"), (int, float))
+        ]
+        if len(scored) >= 2:
+            lo = min(scored, key=lambda p: p["cache_hit_frac"])
+            hi = max(scored, key=lambda p: p["cache_hit_frac"])
+            if hi["cache_hit_frac"] > lo["cache_hit_frac"] \
+                    and hi["tok_s"] <= lo["tok_s"]:
+                violations.append(
+                    f"row {LM_DECODE_ROW!r}: throughput does not "
+                    f"scale with cache hits — "
+                    f"{hi['tok_s']} tok/s at hit_frac="
+                    f"{hi['cache_hit_frac']} vs {lo['tok_s']} tok/s "
+                    f"at hit_frac={lo['cache_hit_frac']} (the "
+                    f"eviction sweep must show the cache paying off)"
+                )
     return violations
 
 

@@ -1,0 +1,51 @@
+"""LM prefill/decode capture tool (ISSUE 19): compile the two paged
+KV-cache generation programs at their committed audit configs and
+write the captures next to the committed traces:
+
+  tools/traces/lm_prefill_t1024_flash.hlo.txt.gz   bucketed prefill
+      (full flash causal forward + page scatter + fused first top-k)
+  tools/traces/lm_decode_b4.hlo.txt.gz             fused decode step
+      (page gather -> 1-token forward -> in-place cache append ->
+      argmax + score update, ONE dispatch per token)
+
+plus a `.report.json` sibling per capture carrying the audit inputs
+(`attn_impl`, `seq_len`, `donated_arg_buffers` — the two pool buffers
+the append must alias in place). `tools/framework_lint.py hlo-audit
+--write-audit` then pins each capture against its
+tools/traces/audit_budgets.json policy: byte budgets, zero host
+transfers inside the programs, the pool-donation check, and no [T,T]
+materialization on the flash prefill at T=1024.
+
+Compilation allocates no live model state beyond the toy-sized params
+and the page pool (~8 MB/buffer), so both captures build on CPU — the
+same no-TPU-needed discipline as tools/profile_longctx.py.
+
+Usage: python tools/profile_lm.py [--out-dir tools/traces]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="write the committed LM prefill/decode captures"
+    )
+    ap.add_argument("--out-dir", default="tools/traces")
+    args = ap.parse_args()
+
+    from bench import write_lm_captures
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    for path in write_lm_captures(args.out_dir):
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
