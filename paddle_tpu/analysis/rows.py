@@ -115,6 +115,29 @@ LM_DECODE_FIELDS = (
 )
 LM_CACHE_SPEEDUP_FLOOR = 1.1
 
+# Elastic pod-scale sparse CTR (ISSUE 20): the `ctr_bigvocab` row is
+# the measured record of the sharded embedding tier's robustness
+# story — a SIGKILLed worker mid-epoch with a sharded-table
+# generation in flight, recovered from per-shard manifests, plus the
+# online-learning hot swap. Its fields are enforced field-by-field:
+# `rows_total` / `rows_touched_frac` pin the pod-scale claim (a
+# 2**30-row table where only the hot set ever materializes),
+# `kill_recover_s` prices the recovery, and the three ZERO fields are
+# correctness invariants, not metrics — a lost batch, a retrained
+# batch, or a request dropped during the rollout swap is a
+# regression even when every throughput number improved.
+CTR_BIGVOCAB_ROW = "ctr_bigvocab"
+CTR_BIGVOCAB_FIELDS = (
+    "rows_total", "rows_touched_frac", "kill_recover_s",
+    "batches_lost", "batches_retrained",
+    "swap_downtime_requests_lost",
+)
+# present AND exactly zero, every run
+CTR_BIGVOCAB_ZERO_FIELDS = (
+    "batches_lost", "batches_retrained",
+    "swap_downtime_requests_lost",
+)
+
 # north-star rows that must carry the timeline triple (ISSUE 10).
 # MUST equal bench.py's NORTH_STARS — check_bench_record's static
 # mode enforces the sync.

@@ -1,5 +1,6 @@
 """Parallelism over the device mesh: data (dp), tensor/model (sharding),
-sequence/context (ring), sharded embeddings (sparse)."""
+sequence/context (ring), sharded embeddings (sparse), and the elastic
+100M–1B-row hot-cache embedding tier (sparse_shard)."""
 
 from paddle_tpu.parallel.dp import (  # noqa: F401
     TrainStep,
@@ -24,4 +25,10 @@ from paddle_tpu.parallel.sparse import (  # noqa: F401
     sparse_apply,
     embedding_lookup,
     touched_rows,
+)
+from paddle_tpu.parallel.sparse_shard import (  # noqa: F401
+    ShardedEmbeddingTable,
+    ShardedTableConfig,
+    adagrad_row_update,
+    sgd_row_update,
 )

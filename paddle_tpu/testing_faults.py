@@ -35,6 +35,67 @@ import sys
 import threading
 
 
+class TransientFault:
+    """Wrap a callable so its first `fail` calls raise `exc`, then it
+    passes through — the deterministic 'NFS hiccup' injector for the
+    async-checkpoint retry/backoff contract (ISSUE 20 satellite).
+
+        cp = AsyncCheckpointer(d)
+        cp._write_shard = TransientFault(cp._write_shard, fail=2)
+
+    The checkpointer's bounded-backoff retry must absorb `fail` <=
+    retries transient OSErrors without ever latching `last_error`;
+    `fail` > retries must still surface."""
+
+    def __init__(self, fn, fail: int = 1, exc: Exception = None):
+        self.fn = fn
+        self.remaining = int(fail)
+        self.exc = exc if exc is not None else OSError(
+            "injected transient write failure"
+        )
+        self.calls = 0
+        self.failures = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            self.failures += 1
+            raise self.exc
+        return self.fn(*args, **kwargs)
+
+
+def write_torn_table_generation(save_dir: str, generation: int,
+                                payloads, fail_after_shard: int,
+                                meta=None, tear: str = "missing"):
+    """Deterministically reproduce a sharded-table checkpoint writer
+    SIGKILLed between table shard `fail_after_shard` and the next one
+    (ISSUE 20 satellite): the generation manifest (written first, as
+    the real writer does) names ALL len(payloads) shards, but only
+    shards 0..fail_after_shard exist on disk.
+
+    tear="missing": the next shard simply never lands (killed before
+    its write began). tear="short": shard `fail_after_shard` itself
+    is additionally truncated to half its bytes AFTER its .ok.json
+    committed (killed mid-flush on a filesystem that reordered the
+    rename) — the checksum path, not just the existence path.
+
+    Reused by the elastic kill/resume tests and the
+    quarantine-and-rebuild tests so torn-recovery is exercised
+    against one canonical injury, not ad-hoc file surgery."""
+    from paddle_tpu.trainer import async_checkpoint as ac
+
+    d = ac.begin_table_generation(save_dir, generation,
+                                  num_shards=len(payloads), meta=meta)
+    last = None
+    for s in range(min(fail_after_shard + 1, len(payloads))):
+        last = ac.write_table_shard(save_dir, generation, s,
+                                    payloads[s])
+    if tear == "short" and last is not None:
+        truncate_file(last, keep_fraction=0.5)
+    return d
+
+
 def kill_process(proc) -> None:
     """SIGKILL a subprocess.Popen and reap it. The process gets no
     chance to flush, ack, or release leases — exactly the crash the
@@ -244,6 +305,141 @@ def start_preemptible_trainer(repo: str, save_dir: str, out_file: str,
     )
 
 
+# ---- elastic sharded-CTR trainer worker (ISSUE 20) ------------------
+#
+# A REAL online-CTR trainer over a ShardedEmbeddingTable: deterministic
+# traffic (trainer/online.make_batch), async sharded-table generations
+# after every batch, and the commit-acknowledged ledger — a batch is
+# logged `{"trained": b}` ONLY after its generation's per-shard sha256
+# manifest verifies on disk. SIGKILL it mid-epoch with writes in
+# flight, respawn the same command line, and the union of ledger
+# lines across incarnations must be every batch EXACTLY once: zero
+# lost (no gaps — committed-but-unlogged batches are reconciled from
+# the recovered manifest), zero retrained (no duplicates —
+# unacknowledged work re-runs without ever double-logging).
+# OUT_FILE records:
+#     {"start": true, "t": wall}                     each incarnation
+#     {"resume": gen, "next_batch": nb,
+#      "quarantined": [{"generation","reason"},...]} on recovery
+#     {"trained": b, "gen": g, "loss": l, "t": wall} on COMMIT ack
+#     {"trained": b, "reconciled": true}             ledger repair
+#     {"done": true, "rows_materialized": m,
+#      "rows_total": R, "evictions": e, "t": wall}   on completion
+SHARDED_CTR_TRAINER_SRC = """
+import json, os, sys, time
+sys.path.insert(0, os.environ["REPO"])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_n = int(os.environ.get("SHARDS", "4"))
+_fl = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _fl:
+    os.environ["XLA_FLAGS"] = (
+        _fl + " --xla_force_host_platform_device_count=%d" % _n).strip()
+import numpy as np
+import jax
+
+from paddle_tpu.core.mesh import MODEL_AXIS, make_mesh
+from paddle_tpu.parallel.sparse_shard import (
+    ShardedEmbeddingTable, ShardedTableConfig, adagrad_row_update,
+    sgd_row_update,
+)
+from paddle_tpu.trainer import online
+
+save_dir = os.environ["SAVE_DIR"]
+out = open(os.environ["OUT_FILE"], "a")
+rows_total = int(os.environ.get("ROWS_TOTAL", str(1 << 30)))
+dim = int(os.environ.get("DIM", "8"))
+capacity = int(os.environ.get("CAPACITY", "64"))
+num_slots = int(os.environ.get("NUM_SLOTS", "48"))
+batches = int(os.environ.get("BATCHES", "24"))
+bsz = int(os.environ.get("BATCH", "8"))
+feats = int(os.environ.get("FEATS", "4"))
+hot = int(os.environ.get("HOT", "96"))
+seed = int(os.environ.get("SEED", "7"))
+lr = float(os.environ.get("LR", "0.5"))
+placement = os.environ.get("PLACEMENT", "range")
+use_adagrad = os.environ.get("ADAGRAD", "0") == "1"
+batch_sleep = float(os.environ.get("BATCH_SLEEP", "0"))
+
+def rec(**kw):
+    out.write(json.dumps(kw) + "\\n")
+    out.flush()
+
+rec(start=True, t=time.time())
+
+mesh = make_mesh({MODEL_AXIS: _n})
+cfg = ShardedTableConfig(
+    rows_total=rows_total, dim=dim, capacity=capacity,
+    num_slots=num_slots, placement=placement, init_scale=0.0,
+    seed=seed)
+table = ShardedEmbeddingTable(
+    cfg, mesh,
+    update_fn=adagrad_row_update(lr) if use_adagrad
+    else sgd_row_update(lr),
+    num_state=1 if use_adagrad else 0)
+trainer = online.OnlineCTRTrainer(table, save_dir)
+hot_ids = online.hot_id_set(seed, hot, rows_total)
+losses = {}
+
+# ---- elastic resume: quarantine-and-rebuild + ledger reconcile ----
+gen, meta, quarantined = trainer.resume()
+next_b = int(meta.get("next_batch", 0)) if gen >= 0 else 0
+if gen >= 0 or quarantined:
+    rec(resume=gen, next_batch=next_b,
+        quarantined=[{"generation": q["generation"],
+                      "reason": q["reason"]} for q in quarantined])
+if gen >= 0:
+    acked = {r["trained"] for r in
+             (json.loads(ln) for ln in open(os.environ["OUT_FILE"]))
+             if "trained" in r}
+    for b in range(next_b):
+        if b not in acked:
+            # committed generation, missing ledger line (killed
+            # between commit and append): acknowledge from the
+            # durable manifest, never by re-running the batch
+            rec(trained=b, reconciled=True)
+
+def ack(pairs):
+    for g, m in pairs:
+        rec(trained=g, gen=g, loss=losses.get(g, m.get("loss")),
+            t=time.time())
+
+for b in range(next_b, batches):
+    ids, labels = online.make_batch(seed, b, bsz, feats, hot_ids)
+    losses[b] = trainer.train_step(ids, labels)
+    # generation b = state after batch b; async, in flight while the
+    # next batch trains (the kill window the elastic test aims at)
+    trainer.save_generation(b, b + 1,
+                            extra_meta={"loss": losses[b]})
+    ack(trainer.poll_acks())
+    if batch_sleep:
+        time.sleep(batch_sleep)
+
+ack(trainer.drain())
+trainer.close()
+rec(done=True, rows_materialized=table.rows_materialized,
+    rows_total=rows_total, evictions=table.stats["evictions"],
+    t=time.time())
+"""
+
+
+def start_sharded_ctr_trainer(repo: str, save_dir: str,
+                              out_file: str,
+                              **env_overrides) -> subprocess.Popen:
+    """Launch the elastic sharded-CTR worker above. Knobs via
+    env_overrides: ROWS_TOTAL, DIM, CAPACITY, NUM_SLOTS, SHARDS,
+    BATCHES, BATCH, FEATS, HOT, SEED, LR, PLACEMENT, ADAGRAD,
+    BATCH_SLEEP — all stringified. Respawn = call again with the same
+    arguments; the worker recovers itself from SAVE_DIR."""
+    env = dict(
+        os.environ, REPO=repo, SAVE_DIR=save_dir, OUT_FILE=out_file,
+        **{k: str(v) for k, v in env_overrides.items()},
+    )
+    return subprocess.Popen(
+        [sys.executable, "-c", SHARDED_CTR_TRAINER_SRC], env=env,
+        cwd=repo, stderr=subprocess.PIPE, text=True,
+    )
+
+
 def replica_program_fn(layers: int = 16, d: int = 256):
     """The canonical serving program for fleet/coldstart harnesses: a
     `layers`-deep tanh MLP over a [B, 8] f32 feed. Both the cache
@@ -282,7 +478,7 @@ from paddle_tpu.obs import flight_recorder as _fr
 _fr.enable_flight_recorder(
     dump_dir=os.environ.get("PADDLE_FLIGHT_DIR") or None)
 
-mode = os.environ.get("REPLICA_MODE", "toy")   # toy | cache | compile
+mode = os.environ.get("REPLICA_MODE", "toy")  # toy|cache|compile|ctr
 model_name = os.environ.get("MODEL_NAME", "m")
 tag = os.environ.get("MODEL_TAG", "v1")
 delay = float(os.environ.get("TOY_DELAY_S", "0.005"))
@@ -321,9 +517,43 @@ class Cached:
                 for i in range(ids.shape[0])]
 
 
+class CTRScorer:
+    # online-learning serving side (ISSUE 20): score CTR requests
+    # from the newest COMMITTED sharded-table generation in
+    # MODEL_DIR. A rollout()'s swap_model frame re-runs _boot_model,
+    # which re-reads the directory — the hot-swap IS "load the
+    # trainer's latest checkpoint", exactly the loop ROADMAP item 4
+    # names. Request ids are feature ids; score = sigmoid(sum of
+    # their learned weights).
+    can_host = False
+    engine = None
+    named_hooks = {}
+    def __init__(self, weights, tag, gen):
+        self.w = weights
+        self.tag = tag
+        self.gen = gen
+    def run_batch(self, ids, lens, hooks, host):
+        import math
+        outs = []
+        for i in range(ids.shape[0]):
+            feats = ids[i, : max(int(lens[i]), 0)]
+            z = sum(self.w.get(int(f), 0.0) for f in feats)
+            p = 1.0 / (1.0 + math.exp(-z))
+            outs.append({"tokens": [int(lens[i])], "score": p,
+                         "tag": self.tag, "gen": self.gen})
+        return outs
+
+
 def _boot_model(new_tag):
     if mode == "toy":
         return Toy(new_tag, delay)
+    if mode == "ctr":
+        from paddle_tpu.trainer import async_checkpoint as ac
+        from paddle_tpu.trainer import online
+        gen, payloads, _meta = ac.load_table_generation(
+            os.environ["MODEL_DIR"], -1)
+        return CTRScorer(online.weights_from_payloads(payloads),
+                         new_tag, gen)
     from paddle_tpu import inference, testing_faults
     if mode == "cache":
         policy = json.loads(os.environ.get("CACHE_POLICY", "null"))
@@ -351,9 +581,9 @@ print("BOOT %s %.6f" % (mode, time.monotonic() - t0), flush=True)
 
 srv = InferenceServer(ServeConfig(
     max_queue=max_queue,
-    max_batch=1 if mode != "toy" else max_batch,
+    max_batch=max_batch if mode in ("toy", "ctr") else 1,
     default_deadline_s=deadline,
-    buckets=(8,) if mode != "toy" else (8, 16, 32, 64),
+    buckets=(8, 16, 32, 64) if mode in ("toy", "ctr") else (8,),
 ))
 srv.add_model(model_name, model)
 
@@ -384,10 +614,11 @@ def start_serving_replica(repo: str, **env_overrides):
     before listening. The boot line ("BOOT <mode> <seconds>" or
     "BOOT_REFUSED <err>") is stashed on `proc.boot_line`.
 
-    Knobs via env_overrides: REPLICA_MODE (toy|cache|compile),
+    Knobs via env_overrides: REPLICA_MODE (toy|cache|compile|ctr),
     MODEL_NAME, MODEL_TAG, TOY_DELAY_S, MAX_QUEUE, MAX_BATCH,
     DEADLINE_S, CACHE_DIR, CACHE_KEY, CACHE_POLICY (JSON), FN_LAYERS,
-    FN_DIM, PORT."""
+    FN_DIM, PORT, MODEL_DIR (ctr: the sharded-table generation dir
+    the scorer loads from — and reloads on every swap_model)."""
     env = dict(
         os.environ, REPO=repo, JAX_PLATFORMS="cpu",
         **{k: str(v) for k, v in env_overrides.items()},

@@ -21,6 +21,7 @@ _LAZY = {
     "SGD": "paddle_tpu.trainer.trainer",
     "AsyncCheckpointer": "paddle_tpu.trainer.async_checkpoint",
     "AsyncCheckpointError": "paddle_tpu.trainer.async_checkpoint",
+    "OnlineCTRTrainer": "paddle_tpu.trainer.online",
 }
 
 

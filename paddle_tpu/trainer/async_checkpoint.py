@@ -41,9 +41,11 @@ import hashlib
 import json
 import os
 import queue
+import random
 import re
 import shutil
 import threading
+import time
 import weakref
 
 import jax
@@ -55,6 +57,26 @@ from paddle_tpu.trainer.checkpoint import _unflatten, _walk_arrays
 MANIFEST = "manifest.json"
 FORMAT = "async-shard-v1"
 _PASS_RE = re.compile(r"^pass-(\d{5})$")
+
+# ---- sharded-table generations (ISSUE 20) ---------------------------
+# A second on-disk format for ShardedEmbeddingTable checkpoints. One
+# directory per GENERATION; unlike async-shard-v1 the manifest is
+# written FIRST (it names every table shard the generation will
+# contain), so a writer SIGKILLed between shard N and N+1 leaves a
+# manifest referencing a missing shard — exactly the torn state
+# `verify_table_generation` must detect AND NAME, and
+# `recover_table` must quarantine.
+#
+#     save_dir/gen-00012/
+#         table_manifest.json   # format, generation, num_shards, meta
+#         table-s0.npz          # shard 0 payload (sparse_shard
+#                               # export_shards dict)
+#         table-s0.ok.json      # keys, nbytes, sha256 — AFTER rename
+#         table-s1.npz ...
+TABLE_MANIFEST = "table_manifest.json"
+TABLE_FORMAT = "sharded-table-v1"
+_GEN_RE = re.compile(r"^gen-(\d{5})$")
+QUARANTINE_DIR = "quarantine"
 
 
 class AsyncCheckpointError(RuntimeError):
@@ -212,6 +234,212 @@ def write_shard(save_dir: str, pass_id: int, payload: dict,
             "meta": dict(meta or {}),
         })
     return d
+
+
+# ---- sharded-table generation API (ISSUE 20) ------------------------
+
+
+def _gen_dir(save_dir: str, generation: int) -> str:
+    return os.path.join(save_dir, f"gen-{generation:05d}")
+
+
+def _table_shard_name(shard_id: int) -> str:
+    return f"table-s{shard_id}.npz"
+
+
+def begin_table_generation(save_dir: str, generation: int,
+                           num_shards: int, meta=None) -> str:
+    """Open generation `generation`: write the manifest naming every
+    shard it WILL contain. Written first on purpose — completeness is
+    judged against this promise, so a writer killed mid-stride leaves
+    a manifest pointing at a missing shard (detected, named, and
+    quarantined by the recovery path) instead of a shorter manifest
+    that lies about what the generation was meant to hold."""
+    d = _gen_dir(save_dir, generation)
+    os.makedirs(d, exist_ok=True)
+    _atomic_write_json(os.path.join(d, TABLE_MANIFEST), {
+        "format": TABLE_FORMAT,
+        "generation": generation,
+        "num_shards": num_shards,
+        "meta": dict(meta or {}),
+    })
+    return d
+
+
+def write_table_shard(save_dir: str, generation: int, shard_id: int,
+                      payload: dict) -> str:
+    """Commit one table shard: atomic npz + .ok.json sha256 sidecar
+    (same tear-proof discipline as async-shard-v1)."""
+    d = _gen_dir(save_dir, generation)
+    shard = os.path.join(d, _table_shard_name(shard_id))
+    tmp = shard[:-4] + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, shard)
+    _atomic_write_json(shard[:-4] + ".ok.json", {
+        "keys": sorted(payload),
+        "nbytes": os.path.getsize(shard),
+        "sha256": _sha256(shard),
+    })
+    return shard
+
+
+def write_table_generation(save_dir: str, generation: int,
+                           payloads, meta=None) -> str:
+    """Synchronous convenience: manifest + every shard in order. The
+    async writer (`AsyncCheckpointer.save_table`) commits through the
+    same two functions, so both paths tear identically under kill."""
+    d = begin_table_generation(save_dir, generation, len(payloads),
+                               meta=meta)
+    for s, payload in enumerate(payloads):
+        write_table_shard(save_dir, generation, s, payload)
+    return d
+
+
+def list_table_generations(save_dir: str) -> list:
+    """Manifested generation ids, ascending (quarantine excluded)."""
+    if not os.path.isdir(save_dir):
+        return []
+    out = []
+    for name in os.listdir(save_dir):
+        m = _GEN_RE.match(name)
+        if m and os.path.exists(
+            os.path.join(save_dir, name, TABLE_MANIFEST)
+        ):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def verify_table_generation(save_dir: str, generation: int) -> tuple:
+    """(ok, reason). Complete iff the manifest exists and EVERY shard
+    it names has a matching npz + .ok.json whose size and sha256
+    verify. The reason always NAMES the offending table shard — the
+    operator of a 1B-row table needs 'table shard 3 of 8 torn', not
+    'checkpoint bad'."""
+    d = _gen_dir(save_dir, generation)
+    try:
+        with open(os.path.join(d, TABLE_MANIFEST)) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        return False, f"table manifest unreadable: {e}"
+    if man.get("format") != TABLE_FORMAT:
+        return False, f"unknown table format {man.get('format')!r}"
+    for s in range(man["num_shards"]):
+        shard = os.path.join(d, _table_shard_name(s))
+        ok_path = shard[:-4] + ".ok.json"
+        if not os.path.exists(shard):
+            return False, (
+                f"table shard {s} of {man['num_shards']}: npz missing"
+            )
+        try:
+            with open(ok_path) as f:
+                ok = json.load(f)
+        except (OSError, ValueError):
+            return False, (
+                f"table shard {s} of {man['num_shards']}: "
+                f"missing/unreadable {ok_path}"
+            )
+        if os.path.getsize(shard) != ok["nbytes"]:
+            return False, (
+                f"table shard {s} of {man['num_shards']}: size "
+                f"{os.path.getsize(shard)} != committed "
+                f"{ok['nbytes']} (torn write)"
+            )
+        if _sha256(shard) != ok["sha256"]:
+            return False, (
+                f"table shard {s} of {man['num_shards']}: "
+                f"checksum mismatch (corrupt)"
+            )
+    return True, "ok"
+
+
+def latest_good_table_generation(save_dir: str) -> int:
+    """Newest generation that verifies, or -1 (torn ones skipped with
+    a warning naming the shard)."""
+    import logging
+
+    for gen in reversed(list_table_generations(save_dir)):
+        ok, reason = verify_table_generation(save_dir, gen)
+        if ok:
+            return gen
+        logging.getLogger("paddle_tpu.trainer").warning(
+            "table gen-%05d rejected (%s); falling back", gen, reason,
+        )
+    return -1
+
+
+def quarantine_table_generation(save_dir: str, generation: int,
+                                reason: str = "") -> str:
+    """Move a torn generation aside into `quarantine/` (never delete:
+    a half-written 1B-row table is evidence, and most of its shards
+    are intact bytes an operator may still want). A `reason.txt`
+    records why. Returns the quarantine path."""
+    qdir = os.path.join(save_dir, QUARANTINE_DIR)
+    os.makedirs(qdir, exist_ok=True)
+    src = _gen_dir(save_dir, generation)
+    dst = os.path.join(qdir, f"gen-{generation:05d}")
+    n = 0
+    while os.path.exists(dst):
+        n += 1
+        dst = os.path.join(qdir, f"gen-{generation:05d}.{n}")
+    os.replace(src, dst)
+    with open(os.path.join(dst, "reason.txt"), "w") as f:
+        f.write(reason + "\n")
+    return dst
+
+
+def load_table_generation(save_dir: str, generation: int = -1) -> tuple:
+    """Load one VERIFIED generation. Returns
+    (generation, [shard payload dict, ...], meta). `generation=-1`
+    loads the newest complete one; an explicit torn generation
+    raises, naming the shard."""
+    if generation < 0:
+        generation = latest_good_table_generation(save_dir)
+        if generation < 0:
+            raise FileNotFoundError(
+                f"no complete sharded-table generation in {save_dir}"
+            )
+    else:
+        ok, reason = verify_table_generation(save_dir, generation)
+        if not ok:
+            raise ValueError(
+                f"table gen-{generation:05d} incomplete: {reason}"
+            )
+    d = _gen_dir(save_dir, generation)
+    with open(os.path.join(d, TABLE_MANIFEST)) as f:
+        man = json.load(f)
+    payloads = []
+    for s in range(man["num_shards"]):
+        with np.load(os.path.join(d, _table_shard_name(s))) as z:
+            payloads.append({k: z[k] for k in z.files})
+    return generation, payloads, man["meta"]
+
+
+def recover_table(save_dir: str) -> tuple:
+    """Quarantine-and-rebuild (the elastic resume entry point): every
+    generation NEWER than the last good one that fails verification
+    is moved to quarantine (reason names the shard), then the last
+    good generation is loaded. Returns
+    (generation, payloads, meta, [quarantine records]) — generation
+    is -1 with empty payloads when nothing has committed yet (cold
+    start)."""
+    quarantined = []
+    good = latest_good_table_generation(save_dir)
+    for gen in list_table_generations(save_dir):
+        if gen <= good:
+            continue
+        ok, reason = verify_table_generation(save_dir, gen)
+        if not ok:
+            path = quarantine_table_generation(save_dir, gen, reason)
+            quarantined.append(
+                {"generation": gen, "reason": reason, "path": path}
+            )
+    if good < 0:
+        return -1, [], {}, quarantined
+    gen, payloads, meta = load_table_generation(save_dir, good)
+    return gen, payloads, meta, quarantined
 
 
 def list_passes(save_dir: str) -> list:
@@ -446,12 +674,31 @@ class AsyncCheckpointer:
     """
 
     def __init__(self, save_dir: str, keep_last: int = 0,
-                 queue_depth: int = 2):
+                 queue_depth: int = 2, retries: int = 3,
+                 retry_base_s: float = 0.05,
+                 retry_max_s: float = 0.5):
         """`keep_last=0` keeps every pass; `keep_last=n` rotates all but
         the newest n COMPLETE passes (the reference's save_only_one is
-        keep_last=1, trainer/ParamUtil.h:77)."""
+        keep_last=1, trainer/ParamUtil.h:77).
+
+        `retries`: transient per-shard write failures (OSError from
+        the background writer — NFS hiccup, momentary ENOSPC) are
+        retried up to this many times with bounded jittered
+        exponential backoff (`retry_base_s` doubling to
+        `retry_max_s`) BEFORE latching into `last_error`. One blip
+        must not poison the checkpointer; a persistent failure still
+        surfaces on the next save()/wait()."""
         self.save_dir = save_dir
         self.keep_last = keep_last
+        self.retries = max(0, int(retries))
+        self.retry_base_s = retry_base_s
+        self.retry_max_s = retry_max_s
+        # instance-level write hooks: the fault-injection tests wrap
+        # these (testing_faults.TransientFault) to fail N writes
+        # deterministically without monkeypatching the module
+        self._write_shard = write_shard
+        self._write_table_shard = write_table_shard
+        self._begin_table_generation = begin_table_generation
         self._q: queue.Queue = queue.Queue(maxsize=max(1, queue_depth))
         # known locks (ISSUE 13): instrumented under the faults
         # shard's lock-order checker (analysis/lock_order.py)
@@ -499,20 +746,63 @@ class AsyncCheckpointer:
             tree["state"] = state
         with self._snap_lock:
             payload = snapshot_shards(tree)
-        self._q.put((pass_id, payload, dict(meta or {})))
+        self._q.put(("pass", pass_id, payload, dict(meta or {})))
+
+    def save_table(self, generation: int, payloads, meta=None) -> None:
+        """Enqueue one sharded-table generation (`sharded-table-v1`):
+        manifest first, then every shard payload with its sha256
+        sidecar, all on the background writer. `payloads` must
+        already own their bytes (ShardedEmbeddingTable.export_shards
+        copies) — the table keeps training while this writes."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        self._raise_if_failed()
+        self._q.put(("table", generation, list(payloads),
+                     dict(meta or {})))
 
     # ---- consumer ----
+    def _retrying(self, fn, *args, **kwargs):
+        """Run one write, retrying TRANSIENT failures (OSError) with
+        bounded jittered exponential backoff. Anything else — or an
+        OSError that outlives the retry budget — propagates to the
+        latch. Every file involved lands via write-to-tmp +
+        os.replace, so a failed attempt never leaves a
+        loadable-looking partial for the retry to trip over."""
+        delay = self.retry_base_s
+        for attempt in range(self.retries + 1):
+            try:
+                return fn(*args, **kwargs)
+            except OSError:
+                if attempt >= self.retries:
+                    raise
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, self.retry_max_s)
+
+    def _commit(self, item):
+        kind = item[0]
+        if kind == "pass":
+            _k, pass_id, payload, meta = item
+            self._retrying(self._write_shard, self.save_dir, pass_id,
+                           payload, meta=meta)
+            if self.keep_last and jax.process_index() == 0:
+                self._rotate(pass_id)
+        else:
+            _k, generation, payloads, meta = item
+            self._retrying(self._begin_table_generation,
+                           self.save_dir, generation, len(payloads),
+                           meta=meta)
+            for s, payload in enumerate(payloads):
+                self._retrying(self._write_table_shard, self.save_dir,
+                               generation, s, payload)
+
     def _worker(self):
         while True:
             item = self._q.get()
             if item is None:
                 self._q.task_done()
                 return
-            pass_id, payload, meta = item
             try:
-                write_shard(self.save_dir, pass_id, payload, meta=meta)
-                if self.keep_last and jax.process_index() == 0:
-                    self._rotate(pass_id)
+                self._commit(item)
             except Exception as e:  # latch; surface on save()/wait()
                 with self._err_lock:
                     if self._last_error is None:

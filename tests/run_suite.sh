@@ -50,7 +50,12 @@ done
 # against N parallel pytest processes makes them flaky.
 # PADDLE_LOCK_CHECK=1 (ISSUE 13): the known locks are created
 # instrumented and conftest's sessionfinish hook fails the shard on
-# any lock-order inversion observed during the fault tier.
+# any lock-order inversion observed during the fault tier. The tier
+# includes the ISSUE 20 elastic sparse-CTR kill/resume tests
+# (test_sparse_shard_elastic.py, test_online_learning.py,
+# test_bench_multichip.py::test_ctr_bigvocab_row_*): SIGKILLed
+# sharded-table workers and subprocess serving replicas run under
+# the same lock-order instrumentation.
 JAX_PLATFORMS=cpu \
   XLA_FLAGS=--xla_force_host_platform_device_count=8 \
   PADDLE_LOCK_CHECK=1 \

@@ -579,3 +579,69 @@ def test_static_pins_lm_rows(tmp_path):
     (work / "bench.py").write_text(src)
     v = cbr.check_static(str(work))
     assert any("lm_decode_paged_tokens_per_s" in x for x in v)
+
+
+def test_compare_ctr_bigvocab_row_schema(tmp_path):
+    """ISSUE 20: the elastic sparse-CTR row must carry its full
+    field set, and batches_lost / batches_retrained /
+    swap_downtime_requests_lost must be PRESENT AND ZERO — a lost or
+    double-counted batch (the exactly-once ledger) or a request
+    dropped during the rollout swap is a correctness regression the
+    record check refuses, synthetic or not."""
+    stdout = tmp_path / "stdout.txt"
+    record = tmp_path / "full.jsonl"
+
+    def lint(row):
+        stdout.write_text(json.dumps(row) + "\n")
+        record.write_text(json.dumps(row) + "\n")
+        return cbr.check_compare(str(stdout), str(record))
+
+    good = {
+        "metric": "ctr_bigvocab_dp8", "value": 0.7,
+        "rows_total": 1 << 30, "rows_touched_frac": 9e-8,
+        "kill_recover_s": 0.7, "batches_lost": 0,
+        "batches_retrained": 0, "swap_downtime_requests_lost": 0,
+        "synthetic": True,
+    }
+    assert lint(good) == []
+    # the unsuffixed row name is matched too
+    assert lint(dict(good, metric="ctr_bigvocab")) == []
+    # a zero-invariant silently omitted
+    v = lint({k: v for k, v in good.items() if k != "batches_lost"})
+    assert any("batches_lost" in x for x in v)
+    # a LOST batch: the per-shard manifests failed their purpose
+    v = lint(dict(good, batches_lost=2))
+    assert any("batches_lost=2" in x and "exactly 0" in x for x in v)
+    # a RETRAINED batch: the ledger double-counted
+    v = lint(dict(good, batches_retrained=1))
+    assert any("batches_retrained=1" in x for x in v)
+    # downtime during the hot swap
+    v = lint(dict(good, swap_downtime_requests_lost=3))
+    assert any("swap_downtime_requests_lost=3" in x for x in v)
+    # shrinking the logical table un-proves the pod-scale claim
+    v = lint(dict(good, rows_total=1 << 20))
+    assert any("rows_total" in x and "2**27" in x for x in v)
+    # a hot set that stopped being a vanishing fraction
+    v = lint(dict(good, rows_touched_frac=0.5))
+    assert any("rows_touched_frac" in x for x in v)
+    # errored / skipped rows stay exempt
+    assert lint({"metric": "ctr_bigvocab_dp8", "value": None,
+                 "error": "RuntimeError: x"}) == []
+    assert lint({"metric": "ctr_bigvocab_dp8",
+                 "skipped": "budget"}) == []
+
+
+def test_static_pins_ctr_bigvocab_row(tmp_path):
+    """Deleting ctr_bigvocab from bench_multichip.py's sweep is a
+    robustness-record regression the static lint catches (ISSUE 20
+    satellite)."""
+    import shutil
+
+    work = tmp_path / "repo"
+    work.mkdir()
+    shutil.copy(os.path.join(REPO, "bench.py"), work / "bench.py")
+    src = open(os.path.join(REPO, "bench_multichip.py")).read()
+    src = src.replace("ctr_bigvocab", "ctr_row_gone")
+    (work / "bench_multichip.py").write_text(src)
+    v = cbr.check_static(str(work))
+    assert any("ctr_bigvocab" in x for x in v)

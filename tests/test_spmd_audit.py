@@ -26,6 +26,7 @@ MC_STEMS = (
     "mc_dp_train",
     "mc_sparse_lookup",
     "mc_sparse_update",
+    "mc_sparse_shard_step",
 )
 
 
@@ -246,11 +247,42 @@ class TestCommittedCaptures:
             "all-to-all"]["count"] >= 2
 
     def test_sparse_captures_never_gather_the_table(self):
-        for stem in ("mc_sparse_lookup", "mc_sparse_update"):
+        for stem in ("mc_sparse_lookup", "mc_sparse_update",
+                     "mc_sparse_shard_step"):
             by_kind = json.load(
                 open(os.path.join(TRACES, stem + ".audit.json"))
             )["collectives"]["by_kind"]
             assert "all-gather" not in by_kind
+
+    def test_seeded_all_gather_fails_sparse_shard_policy(self):
+        """ISSUE 20 satellite: the new all-gather-forbidden policy
+        BITES. Take the good seeded module, swap its all-reduce for
+        an all-gather (the repartition that would pull every hot
+        cache onto every chip), and audit under the committed
+        mc_sparse_shard_step policy: spmd.forbid.all-gather must
+        fail, and the required all-reduce goes missing too."""
+        gathered = GOOD.replace(
+            "ROOT %ar = f32[128,64]{1,0} all-reduce("
+            "f32[128,64]{1,0} %cp), channel_id=2, "
+            "replica_groups={{0,1,2,3,4,5,6,7}}, "
+            "use_global_device_ids=true, to_apply=%add",
+            "ROOT %ag = f32[1024,64]{1,0} all-gather("
+            "f32[128,64]{1,0} %cp), channel_id=2, "
+            "replica_groups={{0,1,2,3,4,5,6,7}}, "
+            "use_global_device_ids=true, dimensions={0}",
+        ).replace("seeded_good", "seeded_gathered")
+        assert "all-gather" in gathered  # the mutation took
+        policy = dict(_budgets()["mc_sparse_shard_step"])
+        by = _checks(gathered, policy)
+        assert not by["spmd.forbid.all-gather"]["ok"]
+        assert by["spmd.forbid.all-gather"]["count"] == 1
+        assert not by["spmd.require.all-reduce"]["ok"]
+        # the committed capture passes the SAME policy object
+        rep = hlo_audit.audit_capture(
+            os.path.join(TRACES, "mc_sparse_shard_step.hlo.txt.gz"),
+            policy,
+        )
+        assert rep["ok"], [c for c in rep["checks"] if not c["ok"]]
 
     def test_tightened_budget_fails_the_committed_capture(self):
         """The exact mechanism by which a future replication/byte

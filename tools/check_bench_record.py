@@ -50,6 +50,13 @@ both run by `tests/test_check_bench_record.py`:
   through the SIGKILL + `admitted_lost`, which must be 0 at both row
   and kill scope) and the coldstart row's raw
   `cache_boot_s`/`compile_boot_s` pair.
+- **ctr_bigvocab** (ISSUE 20): static mode pins the elastic
+  sparse-CTR row in bench_multichip.py; compare mode requires its
+  full field set (pod-scale table stats + recovery time) and that
+  `batches_lost` / `batches_retrained` /
+  `swap_downtime_requests_lost` are PRESENT AND ZERO — the
+  exactly-once ledger and the zero-downtime swap are correctness
+  invariants recorded per run, never implied.
 - **bundle schema** (`bundle` subcommand): static lint of
   flight-recorder bundles (obs/flight_recorder.py) AND fleet
   incident bundles (serving/fleet.py FleetMonitor, ISSUE 17) —
@@ -92,6 +99,9 @@ if _REPO not in sys.path:
 from paddle_tpu.analysis.rows import (  # noqa: E402
     AB_ROWS,
     COLDSTART_FIELDS,
+    CTR_BIGVOCAB_FIELDS,
+    CTR_BIGVOCAB_ROW,
+    CTR_BIGVOCAB_ZERO_FIELDS,
     DECODE_CHAIN_FIELDS,
     DECODE_CHAIN_ROW,
     DECODE_CHAIN_SPEEDUP_FLOOR,
@@ -211,6 +221,15 @@ def check_static(repo_dir: str) -> list:
                 f"longer registered — the elasticity record would "
                 f"silently stop being captured"
             )
+    # the elastic sparse-CTR row (ISSUE 20) is permanent the same
+    # way: kill/resume with the sharded table + the rollout swap
+    if CTR_BIGVOCAB_ROW not in mc_src:
+        violations.append(
+            f"bench_multichip.py: permanent row "
+            f"{CTR_BIGVOCAB_ROW!r} is no longer registered — the "
+            f"elastic sparse-CTR record (exactly-once ledger, "
+            f"zero-downtime swap) would silently stop being captured"
+        )
     # the serving-fleet rows (ISSUE 16) are permanent the same way:
     # the kill sweep and the verified-cache cold start must stay in
     # bench.py's sweep
@@ -397,6 +416,12 @@ def check_compare(stdout_path: str, record_path: str) -> list:
         if m == "serve_coldstart" and "error" not in d \
                 and "skipped" not in d:
             violations.extend(_check_coldstart_row(d))
+        # elastic sparse-CTR gate (ISSUE 20): the ctr_bigvocab row's
+        # zero-invariants must be present and exactly zero
+        if (m == CTR_BIGVOCAB_ROW
+                or m.startswith(CTR_BIGVOCAB_ROW + "_")) \
+                and "error" not in d and "skipped" not in d:
+            violations.extend(_check_ctr_bigvocab_row(d))
         # decode-chain gate (ISSUE 18): the beam-decode row's
         # measured dispatch_chain_depth / chain_speedup must be
         # present, genuinely reduced, and above the floor
@@ -671,6 +696,53 @@ def _check_fleet_row(row: dict) -> list:
                 f"merge and the router's own timing measure the same "
                 f"requests; one of the pipes is broken"
             )
+    return violations
+
+
+def _check_ctr_bigvocab_row(row: dict) -> list:
+    """ctr_bigvocab rows (ISSUE 20): the elastic sparse-CTR record.
+    Every field in CTR_BIGVOCAB_FIELDS must be present — the
+    pod-scale table stats (rows_total, rows_touched_frac), the
+    recovery price (kill_recover_s), and the three zero-invariants —
+    and the zero-invariants must be EXACTLY 0. One batch lost means
+    the per-shard manifests failed their whole purpose; one batch
+    retrained means the commit-acknowledged ledger double-counted;
+    one request lost during the rollout swap means the hot swap had
+    downtime. All three are correctness regressions, not slow rows,
+    synthetic or not."""
+    m = row.get("metric", CTR_BIGVOCAB_ROW)
+    violations = []
+    missing = [f for f in CTR_BIGVOCAB_FIELDS if f not in row]
+    if missing:
+        violations.append(
+            f"row {m!r}: missing field(s) {missing} — the elastic "
+            f"sparse-CTR record must carry the pod-scale table "
+            f"stats, the recovery time, and the zero-invariants"
+        )
+    for f in CTR_BIGVOCAB_ZERO_FIELDS:
+        v = row.get(f)
+        if v is not None and v != 0:
+            violations.append(
+                f"row {m!r}: {f}={v!r} — must be exactly 0 (the "
+                f"exactly-once ledger / zero-downtime swap is a "
+                f"correctness invariant, not a metric)"
+            )
+    rt = row.get("rows_total")
+    if rt is not None and rt < (1 << 27):
+        violations.append(
+            f"row {m!r}: rows_total={rt!r} — the pod-scale claim "
+            f"needs a logical vocabulary of at least 2**27 rows "
+            f"(V-independence makes the big number free; shrinking "
+            f"it un-proves the claim)"
+        )
+    frac = row.get("rows_touched_frac")
+    if frac is not None and not (0 <= frac < 0.01):
+        violations.append(
+            f"row {m!r}: rows_touched_frac={frac!r} — the hot set "
+            f"must be a vanishing fraction of the logical table "
+            f"(< 1%); anything larger means the row stopped "
+            f"exercising the eviction tier"
+        )
     return violations
 
 

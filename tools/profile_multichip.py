@@ -14,6 +14,8 @@ T=32768 ring/ulysses programs capture fine on a laptop):
   mc_dp_train                data-parallel train step (grad allreduce)
   mc_sparse_lookup           row-sharded embedding gather + psum
   mc_sparse_update           its backward: the row-sparse scatter
+  mc_sparse_shard_step       elastic hot-cache tier: fused sparse
+                             lookup+update step over per-shard caches
 
 The committed captures are what `tools/framework_lint.py spmd-audit`
 (analysis/spmd_audit.py) audits against tools/traces/
@@ -43,6 +45,7 @@ ROWS = (
     "mc_dp_train",
     "mc_sparse_lookup",
     "mc_sparse_update",
+    "mc_sparse_shard_step",
 )
 
 
@@ -279,6 +282,60 @@ def capture_sparse_update(n_dev, out_dir, synthetic):
     })
 
 
+def capture_sparse_shard_step(n_dev, out_dir, synthetic):
+    """The elastic sparse-CTR tier (ISSUE 20): one fused
+    lookup+update step over the per-shard HOT caches of a logically
+    2**30-row table (sparse_shard.step_program). The program's shapes
+    are (hot-cache, batch) ONLY — rows_total never reaches the
+    device, so this capture at 2**30 is byte-identical to one at
+    2**20: the audit-visible V-independence claim. Policy: one psum
+    (all-reduce) combines lookup partials; the update is a LOCAL
+    masked delta scatter — any all-gather here means the hot caches
+    were repartitioned onto every chip, which is exactly the failure
+    the tier exists to avoid."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.core.mesh import MODEL_AXIS, make_mesh
+    from paddle_tpu.parallel import sparse_shard as ss
+
+    if synthetic:
+        C, D, N = 64, 8, 32
+    else:
+        C, D, N = 131072, 64, 4096
+    k, n_state = N, 1
+    rows_total = 1 << 30  # documentation only: NOT a program shape
+    mesh = make_mesh({MODEL_AXIS: n_dev})
+    S = n_dev * C
+    sharded = NamedSharding(mesh, P(MODEL_AXIS, None))
+    repl = NamedSharding(mesh, P())
+    cache = jax.device_put(jnp.zeros((S, D), jnp.float32), sharded)
+    state = (jax.device_put(jnp.zeros((S, D), jnp.float32),
+                            sharded),)
+    slots = jax.device_put(jnp.zeros((N,), jnp.int32), repl)
+    uslots = jax.device_put(jnp.zeros((k,), jnp.int32), repl)
+    inv = jax.device_put(jnp.zeros((N,), jnp.int32), repl)
+    grads = jax.device_put(jnp.zeros((N, D), jnp.float32), repl)
+    prog = ss.step_program(
+        mesh, MODEL_AXIS, S, D, N, k, n_state, "float32",
+        ss.adagrad_row_update(0.01),
+    )
+    text = prog.lower(cache, state, slots, uslots, inv,
+                      grads).compile().as_text()
+    _write(out_dir, "mc_sparse_shard_step", text, {
+        "model": "parallel/sparse_shard.py step_program (fused "
+                 "lookup psum + local adagrad delta scatter over "
+                 "per-shard hot caches)",
+        "rows_total": rows_total,
+        "hot_capacity_per_shard": C, "dim": D, "ids": N,
+        "num_slots": k, "optimizer": "adagrad(1 slot)",
+        "mesh": {"model": n_dev},
+        "backend": jax.default_backend(),
+        "synthetic": synthetic,
+    })
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--rows", default=",".join(ROWS))
@@ -316,6 +373,9 @@ def main(argv=None):
         elif row == "mc_sparse_update":
             capture_sparse_update(args.devices, args.out_dir,
                                   args.synthetic)
+        elif row == "mc_sparse_shard_step":
+            capture_sparse_shard_step(args.devices, args.out_dir,
+                                      args.synthetic)
 
 
 if __name__ == "__main__":
